@@ -1,0 +1,23 @@
+"""dlrover_tpu: a TPU-native elastic deep-learning training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of DLRover
+(reference: /root/reference; see SURVEY.md): elastic job orchestration with a
+master/agent control plane, Flash-Checkpoint-style in-memory checkpointing,
+dynamic data sharding, fault/straggler diagnosis, auto-scaling, and a full
+parallelism library (DP/FSDP, tensor, pipeline, sequence/context incl. ring
+attention, expert parallelism) expressed as shardings over a TPU device mesh.
+
+Layering (cluster down to kernel — TPU analogue of SURVEY.md §1):
+
+  L7  CLI: ``dlrover-tpu-run`` (``dlrover_tpu.cli.run``)
+  L5  Job master (1/job): rendezvous, data shards, node inventory, scaling
+  L4  Host agent (1/TPU-VM host): supervises the trainer proc, async ckpt saver
+  L3  Trainer libs: Checkpointer/engines, ElasticTrainer, ShardingClient
+  L2  Acceleration: mesh runtime + parallelism strategies + auto-search
+  L1  Kernels: Pallas flash attention, quantization, grouped matmul, embeddings
+
+The device compute path is pure JAX (pjit/shard_map over a ``jax.sharding.Mesh``
+with ICI/DCN-aware axis layout); the control plane is host-side Python/gRPC/C++.
+"""
+
+__version__ = "0.1.0"

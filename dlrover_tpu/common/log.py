@@ -1,0 +1,28 @@
+"""Structured logger shared by master/agent/trainer processes."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = (
+    "[%(asctime)s] [%(levelname)s] "
+    "[%(filename)s:%(lineno)d:%(funcName)s] %(message)s"
+)
+
+
+def _build_logger() -> logging.Logger:
+    logger = logging.getLogger("dlrover_tpu")
+    if logger.handlers:
+        return logger
+    level = os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+    logger.setLevel(level)
+    handler = logging.StreamHandler(stream=sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+default_logger = _build_logger()

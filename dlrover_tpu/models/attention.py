@@ -1,0 +1,162 @@
+"""Multi-head attention with pluggable kernels and Ulysses-style SP.
+
+Counterpart of the reference's flash-attention module zoo
+(ref ``atorch/atorch/modules/transformer/layers.py:1278-1640``) and its
+Ulysses sequence parallelism
+(ref ``atorch/atorch/auto/opt_lib/sequence_parallel_optimization.py:9-103``,
+``distributed/distributed.py:474-501`` ``_SeqAllToAll``).
+
+TPU-first design notes:
+  * Sequence parallelism needs no hand-written all-to-all: activations enter
+    sharded ``[batch, act_seq, ...]`` (sequence split over the ``seq`` axis)
+    and are constrained to ``[batch, ..., act_heads, ...]`` (heads split over
+    ``seq`` x ``tensor``) inside attention.  GSPMD materializes exactly the
+    Ulysses a2a pair at the boundaries.
+  * The attention math itself is a pluggable ``attention_impl``: ``"xla"``
+    (einsum softmax, XLA-fused) or ``"flash"`` (Pallas flash-attention
+    kernel).  Ring-attention context parallelism lives in
+    ``dlrover_tpu.parallel.ring_attention`` and wraps either impl.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import layers
+from dlrover_tpu.parallel import rules as lr
+
+NEG_INF = -1e15
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference einsum attention; fp32 softmax; shapes [B, S, H, D].
+
+    Supports GQA (H_kv dividing H_q) and packed-sequence masks via
+    ``segment_ids`` — the capability match for the reference's GLM/pack mask
+    support (ref ``layers.py:1255`` ``fa2_with_glm_mask``).
+    """
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    if hq != hkv:
+        group = hq // hkv
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    scale = d ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    sk = k.shape[1]
+    mask = None
+    if causal:
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        mask = qpos >= kpos
+    if segment_ids is not None:
+        seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+        seg = seg[:, None, :, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    """Causal self-attention block with RoPE/GQA and SP-aware shardings."""
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    use_rope: bool = True
+    rope_theta: float = 10000.0
+    use_bias: bool = False
+    dtype: layers.Dtype = jnp.bfloat16
+    param_dtype: layers.Dtype = jnp.float32
+    attention_impl: str = "xla"
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        features = x.shape[-1]
+        if positions is None:
+            positions = jnp.arange(x.shape[1])[None, :]
+
+        q = layers.DenseGeneral(
+            (self.num_heads, self.head_dim),
+            kernel_axes=(lr.EMBED, lr.HEADS, lr.KV),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="query",
+        )(x)
+        k = layers.DenseGeneral(
+            (self.num_kv_heads, self.head_dim),
+            kernel_axes=(lr.EMBED, lr.HEADS, lr.KV),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="key",
+        )(x)
+        v = layers.DenseGeneral(
+            (self.num_kv_heads, self.head_dim),
+            kernel_axes=(lr.EMBED, lr.HEADS, lr.KV),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="value",
+        )(x)
+
+        if self.use_rope:
+            q, k = layers.rotary_embedding(q, k, positions, self.rope_theta)
+
+        # Ulysses boundary: reshard seq-split -> head-split (a2a under SP).
+        attn_spec = (lr.BATCH, None, lr.ACT_HEADS, lr.KV)
+        q = nn.with_logical_constraint(q, attn_spec)
+        k = nn.with_logical_constraint(k, attn_spec)
+        v = nn.with_logical_constraint(v, attn_spec)
+
+        if self.attention_impl == "flash":
+            from dlrover_tpu.ops import flash_attention as fa
+
+            out = fa.mha(
+                q, k, v,
+                causal=True,
+                segment_ids=segment_ids,
+                block_q=self.flash_block_q,
+                block_kv=self.flash_block_kv,
+            )
+        elif self.attention_impl == "xla":
+            out = xla_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        else:
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+        # Ulysses boundary back: head-split -> seq-split.
+        out = nn.with_logical_constraint(out, attn_spec)
+        out = layers.DenseGeneral(
+            features,
+            axis=(-2, -1),
+            kernel_axes=(lr.HEADS, lr.KV, lr.EMBED),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="out",
+        )(out)
+        return out

@@ -1,0 +1,215 @@
+"""Shared NN building blocks, annotated with logical sharding axes.
+
+TPU-native counterparts of the reference's parallel layer zoo
+(ref ``atorch/atorch/modules/distributed_modules/layers.py:239-763``:
+``RowParallelLinear``, ``ColumnParallelLinear``, ``VocabParallelEmbedding``).
+Here a single :class:`DenseGeneral` plays all of those roles — the row/column/
+vocab split is decided by the logical axis names on its kernel, not by the
+module class, so the same model code runs under any strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.parallel import rules as lax_rules
+
+Dtype = Any
+Shape = Tuple[int, ...]
+Initializer = Callable[..., Any]
+
+default_kernel_init = nn.initializers.lecun_normal()
+default_embed_init = nn.initializers.normal(stddev=0.02)
+
+
+def _normalize_axes(axes: Union[int, Iterable[int]], ndim: int) -> Tuple[int, ...]:
+    if isinstance(axes, int):
+        axes = (axes,)
+    return tuple(ax if ax >= 0 else ndim + ax for ax in axes)
+
+
+class DenseGeneral(nn.Module):
+    """Linear layer over arbitrary contraction axes with named kernel axes.
+
+    ``kernel_axes`` gives the logical name of every kernel dim; the rule table
+    (``dlrover_tpu.parallel.rules``) decides which mesh axis each maps to.
+    E.g. a ``('embed', 'mlp')`` kernel under TP rules is a column-parallel
+    linear; ``('mlp', 'embed')`` is row-parallel (XLA inserts the psum).
+    """
+
+    features: Union[int, Tuple[int, ...]]
+    axis: Union[int, Tuple[int, ...]] = -1
+    kernel_axes: Tuple[str, ...] = ()
+    use_bias: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = (
+            (self.features,) if isinstance(self.features, int) else tuple(self.features)
+        )
+        axis = _normalize_axes(self.axis, x.ndim)
+        kernel_shape = tuple(x.shape[a] for a in axis) + features
+        assert len(self.kernel_axes) == len(kernel_shape), (
+            f"kernel_axes {self.kernel_axes} must name every dim of "
+            f"{kernel_shape}"
+        )
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
+            kernel_shape,
+            self.param_dtype,
+        )
+        kernel = kernel.astype(self.dtype)
+        x = x.astype(self.dtype)
+        contract = tuple(range(len(axis)))
+        out = jax.lax.dot_general(
+            x, kernel, ((axis, contract), ((), ()))
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), self.kernel_axes[len(axis):]
+                ),
+                features,
+                self.param_dtype,
+            )
+            out = out + bias.astype(self.dtype)
+        return out
+
+
+class Embed(nn.Module):
+    """Token embedding with vocab-parallel-capable table.
+
+    Counterpart of ``VocabParallelEmbedding`` (ref ``layers.py:549``); the
+    table is named ``('vocab', 'embed')`` so the vocab split and the psum over
+    the tensor axis come from the rule table, not the code.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    embedding_init: Initializer = default_embed_init
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        embedding = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                self.embedding_init, (lax_rules.VOCAB, lax_rules.EMBED)
+            ),
+            (self.num_embeddings, self.features),
+            self.param_dtype,
+        )
+        # plain gather: XLA lowers this to a sharded gather (+psum) when the
+        # table carries a vocab split.
+        out = embedding.astype(self.dtype)[ids]
+        return out
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Project hidden states onto the (tied) embedding table -> logits."""
+        embedding = self.get_variable("params", "embedding")
+        if isinstance(embedding, nn.meta.AxisMetadata):
+            embedding = embedding.unbox()
+        return jnp.dot(x.astype(self.dtype), embedding.astype(self.dtype).T)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm (Llama-style), fp32 accumulation."""
+
+    epsilon: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), (lax_rules.NORM,)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.epsilon)
+        return (y * scale.astype(jnp.float32)).astype(orig_dtype)
+
+
+class LayerNorm(nn.Module):
+    """Standard layernorm (GPT-2 style), fp32 accumulation."""
+
+    epsilon: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), (lax_rules.NORM,)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        y = y * scale.astype(jnp.float32)
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), (lax_rules.NORM,)
+                ),
+                (x.shape[-1],),
+                self.param_dtype,
+            )
+            y = y + bias.astype(jnp.float32)
+        return y.astype(orig_dtype)
+
+
+def make_norm(kind: str, dtype: Dtype, param_dtype: Dtype, name: str) -> nn.Module:
+    if kind == "rmsnorm":
+        return RMSNorm(dtype=dtype, param_dtype=param_dtype, name=name)
+    if kind == "layernorm":
+        return LayerNorm(dtype=dtype, param_dtype=param_dtype, name=name)
+    raise ValueError(f"unknown norm kind {kind!r}")
+
+
+def rotary_embedding(
+    q: jax.Array,
+    k: jax.Array,
+    positions: jax.Array,
+    rope_theta: float = 10000.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Apply rotary position embeddings to q/k of shape [B, S, H, D]."""
+    head_dim = q.shape[-1]
+    half = head_dim // 2
+    freqs = 1.0 / (
+        rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rotate(x):
+        x32 = x.astype(jnp.float32)
+        x1, x2 = x32[..., :half], x32[..., half:]
+        return jnp.concatenate(
+            (x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1
+        ).astype(x.dtype)
+
+    return rotate(q), rotate(k)

@@ -1,0 +1,292 @@
+"""The flagship decoder-only Transformer LM (GPT-2 / Llama family, opt. MoE).
+
+One model covers the reference's example/benchmark families (nanoGPT GPT-2,
+Llama2 — ref ``examples/pytorch/nanogpt/train.py``,
+``atorch/examples/llama2/``): config flags pick learned-position+LayerNorm+GELU
+(GPT-2) or RoPE+RMSNorm+SwiGLU+GQA (Llama), and ``num_experts > 0`` switches
+the MLP to expert-parallel MoE.
+
+TPU-first structure:
+  * layers are ``nn.scan``-stacked: one trace regardless of depth (fast
+    compiles), weights carry a leading ``layers`` dim that the pipeline
+    strategy shards over the ``pipe`` mesh axis;
+  * remat (activation checkpointing — the analogue of the reference's
+    ``checkpoint_optimization``) is a config knob with XLA-friendly policies;
+  * every param/activation is logically annotated so any strategy from
+    ``dlrover_tpu.parallel.rules`` applies without touching model code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import layers
+from dlrover_tpu.models.attention import Attention
+from dlrover_tpu.models.moe import MoEMlp
+from dlrover_tpu.parallel import rules as lr
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    num_kv_heads: int = 0          # 0 -> same as num_heads (no GQA)
+    head_dim: int = 0              # 0 -> d_model // num_heads
+    d_ff: int = 0                  # 0 -> 4*d_model (gelu) or 8/3*d_model (swiglu)
+    max_seq_len: int = 1024
+    position: str = "learned"      # "learned" (GPT-2) | "rope" (Llama)
+    norm: str = "layernorm"        # "layernorm" | "rmsnorm"
+    activation: str = "gelu"       # "gelu" | "swiglu"
+    rope_theta: float = 10000.0
+    use_bias: bool = True          # GPT-2 uses biases, Llama does not
+    tie_embeddings: bool = True
+    # MoE
+    num_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # numerics / execution
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"    # "xla" | "flash"
+    remat: str = "none"            # "none" | "dots" | "full"
+    scan_layers: bool = True
+    logits_dtype: Any = jnp.float32
+
+    @property
+    def resolved_kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.d_model // self.num_heads
+
+    def __post_init__(self):
+        if self.attention_impl not in ("xla", "flash"):
+            raise ValueError(
+                f"attention_impl must be 'xla' or 'flash', got "
+                f"{self.attention_impl!r}"
+            )
+        if self.remat not in _REMAT_POLICIES:
+            raise ValueError(
+                f"remat must be one of {sorted(_REMAT_POLICIES)}, got "
+                f"{self.remat!r}"
+            )
+
+    @property
+    def resolved_d_ff(self) -> int:
+        if self.d_ff:
+            return self.d_ff
+        if self.activation == "swiglu":
+            # Llama convention: ~8/3 * d_model, rounded up to an MXU-friendly
+            # multiple of 128 lanes.
+            return ((8 * self.d_model // 3) + 127) // 128 * 128
+        return 4 * self.d_model
+
+    def num_params(self) -> int:
+        """Approximate parameter count (for MFU/HFU accounting)."""
+        d, v, l = self.d_model, self.vocab_size, self.num_layers
+        h = self.resolved_head_dim * self.num_heads
+        hkv = self.resolved_head_dim * self.resolved_kv_heads
+        attn = d * h + 2 * d * hkv + h * d
+        if self.num_experts:
+            ff = self.num_experts * (
+                (3 if self.activation == "swiglu" else 2)
+                * d * self.resolved_d_ff
+            ) + d * self.num_experts
+        else:
+            ff = (3 if self.activation == "swiglu" else 2) * d * self.resolved_d_ff
+        embed = v * d + (0 if self.position != "learned" else self.max_seq_len * d)
+        head = 0 if self.tie_embeddings else v * d
+        return l * (attn + ff) + embed + head
+
+
+class Mlp(nn.Module):
+    d_ff: int
+    activation: str
+    use_bias: bool
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        h = layers.DenseGeneral(
+            self.d_ff,
+            kernel_axes=(lr.EMBED, lr.MLP),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="wi",
+        )(x)
+        if self.activation == "swiglu":
+            g = layers.DenseGeneral(
+                self.d_ff,
+                kernel_axes=(lr.EMBED, lr.MLP),
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="wg",
+            )(x)
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h)
+        return layers.DenseGeneral(
+            d,
+            kernel_axes=(lr.MLP, lr.EMBED),
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="wo",
+        )(h)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        carry: Tuple[jax.Array, jax.Array],
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+    ) -> Tuple[Tuple[jax.Array, jax.Array], None]:
+        cfg = self.config
+        x, aux = carry
+        x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
+        y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_attn")(x)
+        y = Attention(
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.resolved_kv_heads,
+            head_dim=cfg.resolved_head_dim,
+            use_rope=cfg.position == "rope",
+            rope_theta=cfg.rope_theta,
+            use_bias=cfg.use_bias,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            attention_impl=cfg.attention_impl,
+            name="attn",
+        )(y, positions, segment_ids)
+        x = x + y
+        y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_mlp")(x)
+        if cfg.num_experts:
+            y, layer_aux = MoEMlp(
+                num_experts=cfg.num_experts,
+                d_ff=cfg.resolved_d_ff,
+                top_k=cfg.top_k,
+                capacity_factor=cfg.capacity_factor,
+                activation=cfg.activation,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="moe",
+            )(y)
+            aux = aux + layer_aux
+        else:
+            y = Mlp(
+                d_ff=cfg.resolved_d_ff,
+                activation=cfg.activation,
+                use_bias=cfg.use_bias,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="mlp",
+            )(y)
+        x = x + y
+        x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
+        return (x, aux), None
+
+
+_REMAT_POLICIES = {
+    "none": None,
+    "full": jax.checkpoint_policies.nothing_saveable,
+    # save matmul outputs, recompute elementwise (good HBM/FLOP tradeoff)
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM.  ``__call__(tokens) -> (logits, aux_loss)``."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        embed = layers.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="embed",
+        )
+        x = embed(tokens)
+        if cfg.position == "learned":
+            pos_table = self.param(
+                "pos_embedding",
+                nn.with_logical_partitioning(
+                    layers.default_embed_init, (lr.ACT_SEQ, lr.EMBED)
+                ),
+                (cfg.max_seq_len, cfg.d_model),
+                cfg.param_dtype,
+            )
+            x = x + pos_table.astype(cfg.dtype)[positions]
+        x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
+
+        block_cls = Block
+        policy = _REMAT_POLICIES[cfg.remat]
+        if cfg.remat != "none":
+            block_cls = nn.remat(
+                Block,
+                policy=policy,
+                prevent_cse=not cfg.scan_layers,
+                static_argnums=(),
+            )
+        aux0 = jnp.zeros((), jnp.float32)
+        if cfg.scan_layers:
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: lr.LAYERS},
+            )(cfg, name="blocks")
+            (x, aux), _ = stack((x, aux0), positions, segment_ids)
+        else:
+            carry = (x, aux0)
+            for i in range(cfg.num_layers):
+                carry, _ = block_cls(cfg, name=f"block_{i}")(
+                    carry, positions, segment_ids
+                )
+            x, aux = carry
+
+        x = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_final")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = layers.DenseGeneral(
+                cfg.vocab_size,
+                kernel_axes=(lr.EMBED, lr.VOCAB),
+                use_bias=False,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="lm_head",
+            )(x)
+        logits = nn.with_logical_constraint(
+            logits, (lr.BATCH, lr.ACT_SEQ, lr.VOCAB)
+        )
+        return logits.astype(cfg.logits_dtype), aux * cfg.moe_aux_weight

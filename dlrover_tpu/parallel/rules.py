@@ -1,0 +1,102 @@
+"""Logical-axis sharding rules: the strategy layer of the parallelism library.
+
+Where the reference applies parallelism by *module surgery* (wrapping modules
+in FSDP/DDP, swapping ``nn.Linear`` for ``RowParallelLinear`` — ref
+``atorch/atorch/auto/opt_lib/*`` and
+``atorch/atorch/modules/distributed_modules/layers.py:239-763``), the
+TPU-native design applies it by *naming*: model code annotates every parameter
+and activation with logical axis names, and a strategy is just a rule table
+mapping logical names to mesh axes.  Changing strategy = changing the table;
+XLA inserts the collectives (all-gather for FSDP params, psum for TP partials,
+all-to-all for Ulysses SP and MoE dispatch) automatically.
+
+Strategy equivalences with the reference (SURVEY.md §2.5):
+
+  ===============  =====================================================
+  reference        rule here
+  ===============  =====================================================
+  DDP              ``batch -> ('data',)`` only (params replicated)
+  ZeRO/FSDP        ``embed -> 'fsdp'`` etc. (params sharded over fsdp)
+  TP (Megatron)    ``mlp/heads/vocab -> 'tensor'`` (row/col/vocab split)
+  Ulysses SP       ``act_seq -> 'seq'`` outside attention,
+                   ``act_heads -> ('seq','tensor')`` inside (a2a resharding)
+  MoE / EP         ``expert -> 'expert'`` (a2a token dispatch)
+  ===============  =====================================================
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+from dlrover_tpu.runtime.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+)
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+Rules = Sequence[Tuple[str, MeshAxes]]
+
+# Logical axis names used by all models in dlrover_tpu.models.
+BATCH = "batch"            # activation batch dim
+ACT_SEQ = "act_seq"        # activation sequence dim (sharded under SP)
+ACT_HEADS = "act_heads"    # activation heads dim inside attention
+ACT_EMBED = "act_embed"    # activation embedding dim
+EMBED = "embed"            # param embedding dim (FSDP shard dim)
+MLP = "mlp"                # param MLP hidden dim (TP col split)
+HEADS = "heads"            # param attention heads dim (TP split)
+KV = "kv"                  # param per-head dim
+VOCAB = "vocab"            # param vocab dim (TP vocab split)
+EXPERT = "expert"          # param expert dim (EP shard dim)
+LAYERS = "layers"          # scanned layer dim (pipeline stage dim)
+NORM = "norm"              # 1-D norm scales/biases
+
+
+def make_rules(
+    *,
+    fsdp: bool = True,
+    tensor: bool = True,
+    sequence: bool = True,
+    expert: bool = True,
+    pipeline: bool = False,
+) -> List[Tuple[str, MeshAxes]]:
+    """Build the rule table for a strategy combination.
+
+    All rules are safe to leave on even when the corresponding mesh axis has
+    size 1 (the sharding becomes a no-op), so the default is "everything on"
+    and the mesh shape alone decides the real strategy — mirroring how
+    ``auto_accelerate`` composes optimizations without code changes.
+    """
+    rules: List[Tuple[str, MeshAxes]] = [
+        (BATCH, (DATA_AXIS, FSDP_AXIS)),
+        (ACT_EMBED, TENSOR_AXIS),
+        (KV, None),
+        (NORM, None),
+    ]
+    rules.append((ACT_SEQ, SEQ_AXIS if sequence else None))
+    # Ulysses: heads sharded over the seq (and tensor) axes inside attention,
+    # letting XLA introduce the seq<->heads all-to-all at attention boundaries.
+    rules.append(
+        (ACT_HEADS, ((SEQ_AXIS, TENSOR_AXIS) if sequence else TENSOR_AXIS)
+         if tensor or sequence else None)
+    )
+    rules.append((EMBED, FSDP_AXIS if fsdp else None))
+    if tensor:
+        rules += [(MLP, TENSOR_AXIS), (HEADS, TENSOR_AXIS), (VOCAB, TENSOR_AXIS)]
+    else:
+        rules += [(MLP, None), (HEADS, None), (VOCAB, None)]
+    rules.append((EXPERT, EXPERT_AXIS if expert else None))
+    rules.append((LAYERS, PIPE_AXIS if pipeline else None))
+    return rules
+
+
+# The default "everything composable" rule table.
+DEFAULT_RULES: List[Tuple[str, MeshAxes]] = make_rules()
+
+# Pure data-parallel (DDP-equivalent): replicate params, shard batch.
+DDP_RULES: List[Tuple[str, MeshAxes]] = make_rules(
+    fsdp=False, tensor=False, sequence=False, expert=False
+)

@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Mirrors the reference's test strategy of running distributed logic on a CPU
+fallback backend (SURVEY.md §4: gloo in CI; here a virtual CPU mesh), so all
+sharding/collective paths execute without TPU hardware.
+"""
+
+import os
+
+# The session env pins JAX_PLATFORMS to the real TPU platform and the site
+# customization imports jax at interpreter start, so plain env edits are too
+# late — override through jax.config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

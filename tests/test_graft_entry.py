@@ -1,0 +1,25 @@
+"""Validate the driver entry points (__graft_entry__.py) on the CPU mesh."""
+
+import os
+import sys
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    graft.dryrun_multichip(2)
+
+
+def test_entry_traces():
+    """entry()'s fn must be jit-traceable (full compile check runs on TPU)."""
+    fn, args = graft.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == ()

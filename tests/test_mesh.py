@@ -1,0 +1,47 @@
+"""Mesh runtime tests (dlrover_tpu/runtime/mesh.py)."""
+
+import jax
+import pytest
+
+from dlrover_tpu.runtime import mesh as mesh_lib
+from dlrover_tpu.runtime.mesh import MESH_AXES, ParallelConfig, build_mesh
+
+
+def test_eight_cpu_devices():
+    assert jax.device_count() == 8
+
+
+def test_parallel_config_sizes():
+    cfg = ParallelConfig(tensor=2, fsdp=2)
+    sizes = cfg.sizes(8)
+    assert sizes["tensor"] == 2 and sizes["fsdp"] == 2 and sizes["data"] == 2
+
+
+def test_parallel_config_rejects_bad_sizes():
+    with pytest.raises(ValueError):
+        ParallelConfig(tensor=3).sizes(8)
+    with pytest.raises(ValueError):
+        ParallelConfig(data=2, tensor=2).sizes(8)
+
+
+def test_build_mesh_axes_order():
+    mesh = build_mesh(ParallelConfig(tensor=2, pipe=2, data=2))
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.devices.size == 8
+    assert mesh.shape["tensor"] == 2
+    assert mesh.shape["pipe"] == 2
+    assert mesh.shape["data"] == 2
+
+
+def test_factor_devices():
+    sizes = mesh_lib.factor_devices(8)
+    total = 1
+    for v in sizes.values():
+        total *= v
+    assert total == 8
+
+
+def test_slice_topology():
+    info = mesh_lib.slice_topology()
+    assert info["num_devices"] == 8
+    assert info["platform"] == "cpu"

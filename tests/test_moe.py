@@ -1,0 +1,38 @@
+"""MoE gating unit tests (dlrover_tpu/models/moe.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models.moe import top_k_gating
+
+
+def test_top2_no_slot_collision():
+    """First- and second-choice tokens must never share an (expert, slot)."""
+    # Two tokens prefer expert 0 then 1; two prefer expert 1 then 0.
+    logits = jnp.array(
+        [[[2.0, 1.0], [2.0, 1.0], [1.0, 2.0], [1.0, 2.0]]]
+    )  # [1, 4, 2]
+    dispatch, combine, _ = top_k_gating(logits, k=2, capacity=4)
+    occupancy = np.asarray(dispatch.sum(axis=1))  # [1, E, C]
+    assert occupancy.max() <= 1.0 + 1e-6, occupancy
+    # every token got both choices dispatched (capacity is ample)
+    assert float(dispatch.sum()) == 8.0
+
+
+def test_capacity_drops_overflow():
+    logits = jnp.zeros((1, 8, 2))  # all tokens identical -> same expert order
+    dispatch, _, _ = top_k_gating(logits, k=1, capacity=3)
+    occupancy = np.asarray(dispatch.sum(axis=1))
+    assert occupancy.max() <= 1.0 + 1e-6
+    # only `capacity` tokens make it in
+    assert float(dispatch.sum()) == 3.0
+
+
+def test_combine_weights_normalized():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 16, 4)).astype(np.float32))
+    dispatch, combine, aux = top_k_gating(logits, k=2, capacity=16)
+    # combine weights per token sum to ~1 where both choices kept
+    token_mass = np.asarray(combine.sum(axis=(2, 3)))
+    assert token_mass.max() <= 1.0 + 1e-5
+    assert float(aux) > 0.0

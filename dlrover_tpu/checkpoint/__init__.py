@@ -1,0 +1,10 @@
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+__all__ = [
+    "Checkpointer",
+    "StorageType",
+    "CheckpointEngine",
+    "AsyncCheckpointSaver",
+]

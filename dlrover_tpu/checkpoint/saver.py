@@ -1,0 +1,208 @@
+"""Agent-side async checkpoint saver: drains shm -> storage, commits steps.
+
+Capability ref: ``dlrover/python/elastic_agent/torch/ckpt_saver.py:344-1194``
+(``AsyncCheckpointSaver``: event loop, ``save_step_checkpoint``,
+``commit_checkpoint``, SIGTERM persist).  TPU redesign: one saver per host
+process supervising one shm arena; the commit barrier is done-files polled by
+host 0 (works on any shared filesystem/gcsfuse mount); retention runs behind
+the tracker update so a reader never sees a deleted-but-tracked step.
+"""
+
+from __future__ import annotations
+
+import pickle
+import signal
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.storage import (
+    CheckpointDeletionStrategy,
+    CheckpointDirLayout,
+    CheckpointStorage,
+    KeepLatestStepStrategy,
+    get_checkpoint_storage,
+)
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+from dlrover_tpu.checkpoint.engine import (
+    CheckpointEvent,
+    CheckpointEventType,
+    event_queue_name,
+    lock_name,
+    shm_name,
+)
+
+
+class AsyncCheckpointSaver:
+    """Daemon that persists the shm arena to storage off the training path."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+        host_index: int = 0,
+        num_hosts: int = 1,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+        commit_timeout: float = 600.0,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.storage = storage or get_checkpoint_storage()
+        self.layout = CheckpointDirLayout(checkpoint_dir)
+        self.host_index = host_index
+        self.num_hosts = num_hosts
+        self.deletion_strategy = deletion_strategy or KeepLatestStepStrategy(3)
+        self.commit_timeout = commit_timeout
+        self._shm = SharedMemoryHandler(shm_name(host_index))
+        # The saver side OWNS the queue + lock servers.
+        self._event_queue = SharedQueue(
+            event_queue_name(host_index), create=True
+        )
+        self._lock = SharedLock(lock_name(host_index), create=True)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._persisted_step = -1
+        AsyncCheckpointSaver._instance = self
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-saver", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+        self._event_queue.put(CheckpointEvent(CheckpointEventType.EXIT))
+        if self._thread:
+            self._thread.join(timeout=10)
+        self._event_queue.close()
+        self._lock.close()
+        self._shm.close()
+
+    @classmethod
+    def register_signal_handlers(cls):
+        """Persist shm before dying on SIGTERM (preemption notice).
+
+        Capability ref ``ckpt_saver.py:472-494`` — on TPU, maintenance events
+        and spot preemptions deliver SIGTERM to the host with ~30s grace,
+        enough to flush a host-RAM checkpoint to durable storage.
+        """
+
+        def handler(signum, frame):
+            saver = cls._instance
+            if saver is not None:
+                logger.info("SIGTERM: persisting shm checkpoint before exit")
+                try:
+                    saver.save_shm_to_storage()
+                except Exception as e:
+                    logger.error("SIGTERM persist failed: %s", e)
+            signal.default_int_handler(signum, frame)
+
+        try:
+            signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            logger.warning("not main thread; SIGTERM handler not installed")
+
+    # -- event loop -----------------------------------------------------------
+
+    def _run(self):
+        logger.info(
+            "async saver started (host %d/%d) -> %s",
+            self.host_index, self.num_hosts, self.checkpoint_dir,
+        )
+        while not self._stopped.is_set():
+            event = self._event_queue.get(timeout=1.0)
+            if event is None:
+                continue
+            if event.type == CheckpointEventType.EXIT:
+                break
+            if event.type == CheckpointEventType.SAVE:
+                try:
+                    self.save_step_checkpoint(event.step)
+                except Exception as e:
+                    logger.error("persist of step %d failed: %s", event.step, e)
+
+    # -- persist + commit -----------------------------------------------------
+
+    def save_shm_to_storage(self) -> bool:
+        """Persist whatever is in shm right now (failure/SIGTERM path)."""
+        meta = self._shm.load_meta()
+        if meta is None:
+            return False
+        if meta.step <= self._persisted_step:
+            return True
+        return self.save_step_checkpoint(meta.step)
+
+    def save_step_checkpoint(self, step: int) -> bool:
+        # Hold the shm lock for the whole read so the trainer cannot
+        # overwrite the arena mid-persist (it skips the save instead).
+        if not self._lock.acquire(blocking=True):
+            return False
+        try:
+            meta = self._shm.load_meta()
+            if meta is None or meta.step != step:
+                actual = None if meta is None else meta.step
+                logger.warning(
+                    "shm holds step %s, wanted %d; persisting what exists",
+                    actual, step,
+                )
+                if meta is None:
+                    return False
+                step = meta.step
+            t0 = time.monotonic()
+            step_dir = self.layout.step_dir(step)
+            self.storage.safe_makedirs(step_dir)
+            self.storage.write(
+                pickle.dumps(meta),
+                self.layout.meta_path(step, self.host_index, self.num_hosts),
+            )
+            self.storage.write(
+                bytes(self._shm.raw_data(meta)),
+                self.layout.data_path(step, self.host_index, self.num_hosts),
+            )
+            self.storage.write("ok", self.layout.done_path(step, self.host_index))
+            logger.info(
+                "host %d persisted step %d in %.2fs",
+                self.host_index, step, time.monotonic() - t0,
+            )
+        finally:
+            self._lock.release()
+        if self.host_index == 0:
+            self.commit_checkpoint(step)
+        self._persisted_step = step
+        return True
+
+    def commit_checkpoint(self, step: int):
+        """Host 0 waits for every host's done-file, then flips the tracker."""
+        deadline = time.monotonic() + self.commit_timeout
+        while time.monotonic() < deadline:
+            done = sum(
+                self.storage.exists(self.layout.done_path(step, h))
+                for h in range(self.num_hosts)
+            )
+            if done == self.num_hosts:
+                self.storage.write(str(step), self.layout.tracker_path())
+                self.storage.commit(step, True)
+                logger.info("committed step %d (%d hosts)", step, done)
+                self._clean_up(step)
+                return
+            time.sleep(0.5)
+        logger.error("commit of step %d timed out (%d hosts)", step, self.num_hosts)
+        self.storage.commit(step, False)
+
+    def _clean_up(self, committed_step: int):
+        def delete_fn(step: int):
+            if step == committed_step:
+                return
+            self.storage.safe_rmtree(self.layout.step_dir(step))
+            logger.info("retention: deleted step %d", step)
+
+        try:
+            self.deletion_strategy.clean_up(committed_step, delete_fn)
+        except Exception as e:
+            logger.warning("retention cleanup failed: %s", e)

@@ -1,0 +1,165 @@
+"""Checkpoint storage backends + retention policies.
+
+Capability parity with ref ``dlrover/python/common/storage.py:24-328``
+(``PosixDiskStorage``, ``KeepStepIntervalStrategy``, ``KeepLatestStepStrategy``)
+with a TPU-cloud slant: the canonical durable tier is an object store (GCS),
+which on TPU VMs is mounted via gcsfuse or addressed through a same-API path
+writer — both are covered by the posix backend here, and a dedicated
+multipart GCS client can slot in behind the same interface.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointDeletionStrategy(ABC):
+    """Decides which persisted step directories to clean up."""
+
+    @abstractmethod
+    def clean_up(self, step: int, delete_fn) -> None:
+        """Called after ``step`` commits; ``delete_fn(step)`` removes one."""
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    def __init__(self, max_to_keep: int = 1):
+        self._max_to_keep = max(1, max_to_keep)
+        self._steps: List[int] = []
+
+    def clean_up(self, step: int, delete_fn) -> None:
+        self._steps.append(step)
+        while len(self._steps) > self._max_to_keep:
+            delete_fn(self._steps.pop(0))
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep every ``keep_interval``-th step, delete the rest."""
+
+    def __init__(self, keep_interval: int):
+        self._keep_interval = keep_interval
+
+    def clean_up(self, step: int, delete_fn) -> None:
+        if step % self._keep_interval:
+            delete_fn(step)
+
+
+class CheckpointStorage(ABC):
+    @abstractmethod
+    def write(self, content, path: str) -> None: ...
+
+    @abstractmethod
+    def read(self, path: str, mode: str = "rb"): ...
+
+    @abstractmethod
+    def safe_rmtree(self, dir_path: str) -> None: ...
+
+    @abstractmethod
+    def safe_makedirs(self, dir_path: str) -> None: ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool: ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> List[str]: ...
+
+    def commit(self, step: int, success: bool) -> None:
+        """Hook called once a step's files are all durable."""
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local disk / NFS / gcsfuse-mounted bucket."""
+
+    def write(self, content, path: str) -> None:
+        mode = "wb" if isinstance(content, (bytes, memoryview)) else "w"
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, path: str, mode: str = "rb"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_rmtree(self, dir_path: str) -> None:
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def safe_makedirs(self, dir_path: str) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+
+def get_checkpoint_storage(
+    deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+) -> CheckpointStorage:
+    return PosixDiskStorage()
+
+
+class CheckpointDirLayout:
+    """Canonical on-storage layout of one job's checkpoints.
+
+    checkpoint_dir/
+      tracker.txt                 <- latest committed step (atomic replace)
+      step_{N}/
+        host_{i}_of_{n}.meta      <- pickled tensor index for host i
+        host_{i}_of_{n}.data      <- raw tensor bytes for host i
+        host_{i}.done             <- per-host done marker
+    """
+
+    TRACKER = "tracker.txt"
+
+    def __init__(self, checkpoint_dir: str):
+        self.root = checkpoint_dir
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step}")
+
+    def meta_path(self, step: int, host: int, num_hosts: int) -> str:
+        return os.path.join(
+            self.step_dir(step), f"host_{host}_of_{num_hosts}.meta"
+        )
+
+    def data_path(self, step: int, host: int, num_hosts: int) -> str:
+        return os.path.join(
+            self.step_dir(step), f"host_{host}_of_{num_hosts}.data"
+        )
+
+    def done_path(self, step: int, host: int) -> str:
+        return os.path.join(self.step_dir(step), f"host_{host}.done")
+
+    def tracker_path(self) -> str:
+        return os.path.join(self.root, self.TRACKER)
+
+    def latest_step(self, storage: CheckpointStorage) -> int:
+        content = storage.read(self.tracker_path(), "r")
+        if not content:
+            return -1
+        try:
+            return int(content.strip())
+        except ValueError:
+            logger.warning("corrupt tracker file: %r", content)
+            return -1
+
+    def committed_steps(self, storage: CheckpointStorage) -> List[int]:
+        steps = []
+        for name in storage.listdir(self.root):
+            if name.startswith("step_"):
+                try:
+                    steps.append(int(name[len("step_"):]))
+                except ValueError:
+                    continue
+        return sorted(steps)

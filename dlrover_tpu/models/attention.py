@@ -49,13 +49,13 @@ def xla_attention(
     """
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
-    if hq != hkv:
-        group = hq // hkv
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
+    group = hq // hkv
     scale = d ** -0.5
+    # GQA via broadcast, not jnp.repeat: grouping q keeps K/V (and their
+    # remat recompute) at H_kv width instead of inflating HBM by `group`x.
+    qg = q.reshape(b, sq, hkv, group, d)
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     ) * scale
     sk = k.shape[1]
     mask = None
@@ -65,12 +65,13 @@ def xla_attention(
         mask = qpos >= kpos
     if segment_ids is not None:
         seg = segment_ids[:, :, None] == segment_ids[:, None, :]
-        seg = seg[:, None, :, :]
-        mask = seg if mask is None else jnp.logical_and(mask, seg)
+        seg = seg[:, None, None, :, :]
+        mask = seg if mask is None else jnp.logical_and(mask[None, None], seg)
     if mask is not None:
         logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, sq, hq, d)
 
 
 class Attention(nn.Module):

@@ -224,6 +224,13 @@ class TransformerLM(nn.Module):
         segment_ids: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
+        if cfg.position == "learned" and tokens.shape[1] > cfg.max_seq_len:
+            # XLA gather would silently clamp overflow positions to the last
+            # table row — make it loud (RoPE has no such limit).
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_seq_len "
+                f"{cfg.max_seq_len} of the learned position table"
+            )
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
         embed = layers.Embed(

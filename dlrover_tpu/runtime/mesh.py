@@ -153,8 +153,9 @@ def factor_devices(
     n: int, priority: Sequence[str] = (TENSOR_AXIS, PIPE_AXIS, DATA_AXIS)
 ) -> Dict[str, int]:
     """Greedily split ``n`` devices over axes in ``priority`` order by
-    repeatedly assigning the smallest prime factor.  Used by dry-run and
-    auto-config paths when no explicit :class:`ParallelConfig` is given."""
+    round-robin assigning the smallest remaining prime factor.  Axes not in
+    ``priority`` stay at 1; include ``"data"`` in ``priority`` for it to
+    receive a share."""
     sizes = {a: 1 for a in MESH_AXES}
     remaining = n
     idx = 0

@@ -45,7 +45,15 @@ def make_optimizer(
     decay_steps: int = 0,
     **kwargs,
 ) -> optax.GradientTransformation:
-    if warmup_steps or decay_steps:
+    if warmup_steps and not decay_steps:
+        # Warmup-only: ramp to peak then hold (a cosine schedule here would
+        # collapse to end_value one step after warmup).
+        schedule = optax.linear_schedule(
+            init_value=0.0,
+            end_value=learning_rate,
+            transition_steps=max(1, warmup_steps),
+        )
+    elif warmup_steps or decay_steps:
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0,
             peak_value=learning_rate,

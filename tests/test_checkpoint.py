@@ -1,0 +1,190 @@
+"""Flash Checkpoint tests: IPC primitives, shm packing, engine/saver cycle.
+
+Mirrors the reference's test approach (SURVEY.md §4:
+``test_ckpt_saver.py``/``checkpoint_egine_test.py`` exercise shm handler +
+saver single-node with temp dirs as storage).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import multi_process as mp_ipc
+from dlrover_tpu.common.storage import (
+    CheckpointDirLayout,
+    KeepLatestStepStrategy,
+    KeepStepIntervalStrategy,
+    PosixDiskStorage,
+)
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler, assemble_tensor
+
+
+@pytest.fixture(autouse=True)
+def _socket_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+
+
+def test_shared_queue_lock_dict_cross_object(tmp_path):
+    server_q = mp_ipc.SharedQueue("q1", create=True)
+    client_q = mp_ipc.SharedQueue("q1", create=False)
+    client_q.put({"step": 3})
+    assert server_q.get(timeout=2) == {"step": 3}
+    assert client_q.get(timeout=0.1, default="empty") == "empty"
+
+    server_l = mp_ipc.SharedLock("l1", create=True)
+    client_l = mp_ipc.SharedLock("l1", create=False)
+    assert client_l.acquire()
+    assert not server_l.acquire(blocking=False)
+    assert client_l.release()
+    assert server_l.acquire(blocking=False)
+    server_l.release()
+
+    server_d = mp_ipc.SharedDict("d1", create=True)
+    client_d = mp_ipc.SharedDict("d1", create=False)
+    client_d.set("k", [1, 2])
+    assert server_d.get("k") == [1, 2]
+    client_d.update({"a": 1, "b": 2})
+    assert set(server_d.snapshot()) == {"k", "a", "b"}
+    for obj in (server_q, server_l, server_d):
+        obj.close()
+
+
+def test_shm_handler_roundtrip():
+    handler = SharedMemoryHandler(f"t{os.getpid()}")
+    state = {
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "b": np.ones(5, np.int32),
+        "nested": {"s": jnp.float32(2.5)},
+    }
+    meta = handler.save_state_dict(state, step=7, extra={"note": "x"})
+    assert meta.step == 7
+
+    reader = SharedMemoryHandler(f"t{os.getpid()}")
+    meta2 = reader.load_meta()
+    assert meta2.step == 7 and meta2.extra == {"note": "x"}
+    arrays = {
+        t.path: assemble_tensor(t, lambda r: reader.load_block(meta2, r))
+        for t in meta2.tensors
+    }
+    flat = {p: a for p, a in arrays.items()}
+    w = [a for p, a in flat.items() if "'w'" in "".join(p)][0]
+    np.testing.assert_array_equal(
+        w, np.arange(12, dtype=np.float32).reshape(3, 4)
+    )
+    handler.close(unlink=True)
+    reader.close()
+
+
+def test_shm_handler_sharded_array():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("x",))
+    arr = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        NamedSharding(mesh, PartitionSpec("x")),
+    )
+    handler = SharedMemoryHandler(f"s{os.getpid()}")
+    meta = handler.save_state_dict({"p": arr}, step=1)
+    t = meta.tensors[0]
+    assert t.global_shape == (8, 4)
+    assert len(t.shards) == 4  # one block per device shard
+    out = assemble_tensor(t, lambda r: handler.load_block(meta, r))
+    np.testing.assert_array_equal(out, np.asarray(arr))
+    handler.close(unlink=True)
+
+
+def test_checkpointer_memory_and_disk_cycle(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(ckpt_dir, host_index=0, num_hosts=1, local_saver=True)
+    state = {
+        "params": {"w": jnp.ones((4, 4)) * 3.0},
+        "step": jnp.int32(11),
+    }
+    assert ckpt.save_checkpoint(11, state, StorageType.MEMORY)
+    step, loaded = ckpt.load_checkpoint(state_template=state)
+    assert step == 11
+    np.testing.assert_allclose(loaded["params"]["w"], np.ones((4, 4)) * 3.0)
+
+    state["step"] = jnp.int32(12)
+    state["params"]["w"] = jnp.ones((4, 4)) * 4.0
+    assert ckpt.save_checkpoint(12, state, StorageType.DISK)
+    assert ckpt.wait(timeout=30)
+    layout = CheckpointDirLayout(ckpt_dir)
+    storage = PosixDiskStorage()
+    assert layout.latest_step(storage) == 12
+
+    # A fresh process-equivalent: new Checkpointer, shm gone -> storage load.
+    ckpt._engine._shm.close(unlink=True)
+    ckpt2 = Checkpointer(
+        str(tmp_path / "ckpt"), host_index=0, num_hosts=1, local_saver=False
+    )
+    # reuse the running saver's queue/lock from ckpt's local saver
+    step, loaded = ckpt2._engine.load_from_storage(
+        treedef=jax.tree_util.tree_structure(state)
+    )
+    assert step == 12
+    np.testing.assert_allclose(loaded["params"]["w"], np.ones((4, 4)) * 4.0)
+    ckpt.close()
+
+
+def test_restore_with_resharding(tmp_path):
+    """Save under one sharding, restore under another (elastic resize)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(ckpt_dir, host_index=0, num_hosts=1, local_saver=True)
+    mesh4 = Mesh(np.asarray(jax.devices()[:4]), ("x",))
+    arr = jax.device_put(
+        jnp.arange(16, dtype=jnp.float32).reshape(8, 2),
+        NamedSharding(mesh4, PartitionSpec("x")),
+    )
+    assert ckpt.save_checkpoint(5, {"w": arr}, StorageType.DISK)
+    assert ckpt.wait(timeout=30)
+
+    mesh2 = Mesh(np.asarray(jax.devices()[:2]), ("x",))
+    new_sharding = {"w": NamedSharding(mesh2, PartitionSpec(None, "x"))}
+    step, state = ckpt.load_checkpoint(
+        shardings=new_sharding, state_template={"w": arr}
+    )
+    assert step == 5
+    assert state["w"].sharding.mesh.shape["x"] == 2
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.asarray(arr))
+    ckpt.close()
+
+
+def test_deletion_strategies(tmp_path):
+    deleted = []
+    keep_latest = KeepLatestStepStrategy(max_to_keep=2)
+    for s in [10, 20, 30, 40]:
+        keep_latest.clean_up(s, deleted.append)
+    assert deleted == [10, 20]
+
+    deleted = []
+    keep_interval = KeepStepIntervalStrategy(keep_interval=100)
+    for s in [50, 100, 150, 200]:
+        keep_interval.clean_up(s, deleted.append)
+    assert deleted == [50, 150]
+
+
+def test_saver_sigterm_persist_path(tmp_path):
+    """save_shm_to_storage persists un-flushed shm (preemption path)."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    # no saver.start(): simulate event loop not draining
+    engine = CheckpointEngine(ckpt_dir, host_index=0, num_hosts=1)
+    engine.save_to_memory(33, {"w": jnp.full((2, 2), 9.0)})
+    assert saver.save_shm_to_storage()
+    layout = CheckpointDirLayout(ckpt_dir)
+    assert layout.latest_step(PosixDiskStorage()) == 33
+    engine.close()
+    saver.stop()

@@ -1,0 +1,170 @@
+"""The only channel node -> master: retry-wrapped typed calls over gRPC.
+
+Capability ref: ``dlrover/python/elastic_agent/master_client.py:50-443``
+(``join_rendezvous``, ``get_comm_world``, ``report_failures``,
+``report_heart_beat``, kv_store accessors; every call retried).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Dict, Optional
+
+import grpc
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master import messages as msg
+from dlrover_tpu.master.servicer import GET, REPORT
+
+
+def retry(func):
+    def wrapped(self, *args, **kwargs):
+        last = None
+        for attempt in range(self._retries):
+            try:
+                return func(self, *args, **kwargs)
+            except grpc.RpcError as e:
+                last = e
+                if attempt + 1 < self._retries:
+                    time.sleep(min(2 ** attempt, 10))
+        raise ConnectionError(
+            f"master unreachable at {self._addr}: {last}"
+        ) from last
+
+    return wrapped
+
+
+class MasterClient:
+    def __init__(
+        self,
+        addr: str,
+        node_id: int = 0,
+        node_type: str = "worker",
+        retries: int = 5,
+    ):
+        self._addr = addr
+        self.node_id = node_id
+        self.node_type = node_type
+        self._retries = retries
+        self._channel = grpc.insecure_channel(addr)
+        self._report = self._channel.unary_unary(
+            REPORT,
+            request_serializer=pickle.dumps,
+            response_deserializer=pickle.loads,
+        )
+        self._get = self._channel.unary_unary(
+            GET,
+            request_serializer=pickle.dumps,
+            response_deserializer=pickle.loads,
+        )
+
+    def _envelope(self, payload) -> msg.Envelope:
+        return msg.Envelope(
+            node_id=self.node_id, node_type=self.node_type, payload=payload
+        )
+
+    @retry
+    def report(self, payload) -> msg.Response:
+        return self._report(self._envelope(payload), timeout=30)
+
+    @retry
+    def get(self, payload) -> msg.Response:
+        return self._get(self._envelope(payload), timeout=30)
+
+    def ping(self, timeout: float = 2.0) -> bool:
+        try:
+            self._get(
+                self._envelope(msg.JobStatusRequest()), timeout=timeout
+            )
+            return True
+        except grpc.RpcError:
+            return False
+
+    # -- rendezvous -----------------------------------------------------------
+
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = "elastic-training",
+        node_unit: int = 1,
+    ) -> int:
+        response = self.report(
+            msg.JoinRendezvous(
+                node_rank, local_world_size, rdzv_name, node_unit
+            )
+        )
+        return response.payload
+
+    def get_comm_world(
+        self, node_rank: int, rdzv_name: str = "elastic-training"
+    ) -> msg.RendezvousState:
+        return self.get(msg.CommWorldRequest(node_rank, rdzv_name)).payload
+
+    def num_nodes_waiting(self, rdzv_name: str = "elastic-training") -> int:
+        return self.get(msg.WaitingNodesRequest(rdzv_name)).payload
+
+    def report_network_status(
+        self, node_rank: int, normal: bool, elapsed: float
+    ):
+        self.report(msg.NetworkStatus(node_rank, normal, elapsed))
+
+    # -- data sharding --------------------------------------------------------
+
+    def create_dataset(self, params: msg.DatasetShardParams):
+        self.report(params)
+
+    def get_task(self, dataset_name: str) -> msg.ShardTask:
+        return self.get(msg.TaskRequest(dataset_name, self.node_id)).payload
+
+    def report_task(self, dataset_name: str, task_id: int, success=True):
+        self.report(msg.TaskResult(task_id, dataset_name, success))
+
+    def get_shard_checkpoint(self, dataset_name: str) -> msg.ShardCheckpoint:
+        return self.get(msg.ShardCheckpointRequest(dataset_name)).payload
+
+    def restore_shard_checkpoint(self, ckpt: msg.ShardCheckpoint):
+        self.report(ckpt)
+
+    # -- kv store -------------------------------------------------------------
+
+    def kv_put(self, key: str, value: bytes):
+        self.report(msg.KVPut(key, value))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.get(msg.KVGet(key)).payload
+
+    def kv_add(self, key: str, amount: int = 1) -> int:
+        return self.get(msg.KVAdd(key, amount)).payload
+
+    # -- telemetry / lifecycle ------------------------------------------------
+
+    def report_step(self, step: int, tokens: int = 0, loss: float = 0.0):
+        self.report(msg.StepReport(step, tokens=tokens, loss=loss))
+
+    def report_heartbeat(self, diagnosis: Optional[Dict] = None):
+        self.report(msg.HeartBeat(self.node_id, diagnosis=diagnosis or {}))
+
+    def report_failure(
+        self, error: str, exit_code: int = 1, level: str = "process",
+        restart_count: int = 0,
+    ) -> str:
+        response = self.report(
+            msg.NodeFailure(
+                self.node_id, error, exit_code, restart_count, level
+            )
+        )
+        return response.payload
+
+    def report_event(self, event: str, detail: str = ""):
+        self.report(msg.NodeEventReport(self.node_id, event, detail))
+
+    def get_job_status(self) -> msg.JobStatus:
+        return self.get(msg.JobStatusRequest()).payload
+
+    def get_paral_config(self) -> msg.ParalConfig:
+        return self.get(msg.ParalConfigRequest(self.node_id)).payload
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,133 @@
+"""Pre-flight node health probes: chip matmul TFLOPs + collective bandwidth.
+
+Capability ref: ``dlrover/trainer/torch/node_check/nvidia_gpu.py:24`` +
+``utils.py:58-196`` (``matmul`` stress + ``bm_allgather`` timed) and the
+agent driver ``training.py:828-977`` (``NodeCheckElasticAgent``).
+
+TPU redesign: probes run *in the agent's own process* on the local chips (no
+fork-per-device), measuring (a) bf16 matmul sustained TFLOPs on every local
+chip — catches degraded/thermally-limited chips, and (b) psum all-reduce
+bandwidth across local chips over ICI — catches bad ICI links.  Elapsed time
+is reported to the master's NetworkCheckRendezvousManager, which runs the
+pairwise bisection (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def matmul_probe(
+    matrix_dim: int = 4096, iters: int = 8, device=None
+) -> float:
+    """Sustained bf16 matmul TFLOPs on one device."""
+    import jax
+    import jax.numpy as jnp
+
+    device = device or jax.devices()[0]
+    key = jax.random.PRNGKey(0)
+    x = jax.device_put(
+        jax.random.normal(key, (matrix_dim, matrix_dim), jnp.bfloat16), device
+    )
+
+    @jax.jit
+    def chain(x):
+        for _ in range(iters):
+            x = x @ x
+            # Renormalize so the chain is numerically tame (jit-fused, cheap).
+            x = x * jax.lax.rsqrt(jnp.float32(matrix_dim)).astype(x.dtype)
+        return x
+
+    chain(x).block_until_ready()  # compile
+    t0 = time.monotonic()
+    chain(x).block_until_ready()
+    dt = time.monotonic() - t0
+    flops = 2 * matrix_dim**3 * iters
+    return flops / dt / 1e12
+
+
+def allreduce_probe(size_mb: int = 64) -> Tuple[float, float]:
+    """(elapsed_s, algo_bw_GBps) of a psum across all local devices over ICI."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devices = jax.local_devices()
+    n = len(devices)
+    nelem = size_mb * (1 << 20) // 4
+    if n < 2:
+        return 0.0, 0.0
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.asarray(devices), ("d",))
+    x = jax.device_put(
+        jnp.ones((n, nelem), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("d")),
+    )
+
+    @jax.jit
+    def reduce(x):
+        return x.sum(axis=0)  # all-reduce over the sharded dim
+
+    reduce(x).block_until_ready()
+    t0 = time.monotonic()
+    reduce(x).block_until_ready()
+    dt = time.monotonic() - t0
+    gb = nelem * 4 / 1e9
+    return dt, gb / dt if dt > 0 else 0.0
+
+
+def run_probe_payload(matrix_dim: int = 4096) -> Tuple[bool, float]:
+    """The full per-host probe: returns (healthy, elapsed_seconds)."""
+    import jax
+
+    t0 = time.monotonic()
+    try:
+        tflops = []
+        for device in jax.local_devices():
+            tflops.append(matmul_probe(matrix_dim, device=device))
+        dt, bw = allreduce_probe()
+        elapsed = time.monotonic() - t0
+        logger.info(
+            "node check: matmul %s TFLOPs, allreduce %.1f GB/s, %.2fs",
+            [f"{t:.1f}" for t in tflops], bw, elapsed,
+        )
+        return True, elapsed
+    except Exception as e:
+        logger.error("node check probe failed: %s", e)
+        return False, time.monotonic() - t0
+
+
+def run_network_check(
+    client, node_rank: int, rounds: int = 2, timeout: float = 300.0
+) -> bool:
+    """Drive the check rounds against the master; returns node health.
+
+    ref ``training.py:1054-1118``: each round joins the network-check
+    rendezvous, runs the probe, reports status+elapsed, and asks the master
+    for the fault verdict; round 2 re-pairs suspects (master side).
+    """
+    from dlrover_tpu.master.rdzv_manager import RendezvousName
+
+    for check_round in range(rounds):
+        client.join_rendezvous(
+            node_rank, 1, RendezvousName.NETWORK_CHECK
+        )
+        deadline = time.monotonic() + timeout
+        world = {}
+        while time.monotonic() < deadline:
+            state = client.get_comm_world(
+                node_rank, RendezvousName.NETWORK_CHECK
+            )
+            if state.world:
+                world = state.world
+                break
+            time.sleep(0.5)
+        healthy, elapsed = run_probe_payload()
+        client.report_network_status(node_rank, healthy, elapsed)
+        if not healthy:
+            return False
+    return True

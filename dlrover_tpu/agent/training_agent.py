@@ -1,0 +1,298 @@
+"""Per-host elastic agent: supervise the trainer process, restart on failure.
+
+Capability ref: ``dlrover/python/elastic_agent/torch/training.py:352-715``
+(``ElasticTrainingAgent``: ``_rendezvous``, ``_invoke_run`` monitor loop,
+``_restart_workers``, ``_membership_changed``, ``_save_ckpt_to_storage``)
+and ``MasterRendezvousHandler:172-349``.
+
+TPU redesign: the reference forks one worker per GPU; on TPU one host process
+drives all local chips (jax multi-controller), so the agent supervises a
+single trainer subprocess and elasticity is host-granular.  The rendezvous
+world {host_rank: chip_count} becomes ``jax.distributed.initialize``
+coordinates passed through the environment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from enum import Enum
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+from dlrover_tpu.master.rdzv_manager import RendezvousName
+
+# Environment contract agent -> trainer.
+ENV_MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+ENV_NODE_ID = "DLROVER_TPU_NODE_ID"
+ENV_COORDINATOR = "DLROVER_TPU_COORDINATOR"
+ENV_NUM_PROC = "DLROVER_TPU_NUM_PROCESSES"
+ENV_PROC_ID = "DLROVER_TPU_PROCESS_ID"
+ENV_RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+
+_COORD_PORT_KEY = "rdzv/coordinator/{round}"
+
+
+@dataclasses.dataclass
+class ElasticLaunchConfig:
+    """ref ``ElasticLaunchConfig`` ``training.py:112-162``."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_unit: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = 5.0
+    network_check: bool = False
+    save_at_breakpoint: bool = False
+    checkpoint_dir: str = ""
+    rdzv_timeout: float = 600.0
+    local_world_size: int = 0  # 0 -> discover (local chip count)
+
+
+class RunResult(Enum):
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+class MasterRendezvousHandler:
+    """Join master rendezvous, poll for the sealed world, agree coordinator."""
+
+    def __init__(
+        self, client: MasterClient, node_rank: int, config: ElasticLaunchConfig
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._config = config
+
+    def next_rendezvous(self) -> Dict:
+        """Returns {round, world, rank, coordinator}."""
+        local_world = self._config.local_world_size or 1
+        self._client.join_rendezvous(
+            self._node_rank, local_world,
+            RendezvousName.TRAINING, self._config.node_unit,
+        )
+        deadline = time.monotonic() + self._config.rdzv_timeout
+        while time.monotonic() < deadline:
+            state = self._client.get_comm_world(
+                self._node_rank, RendezvousName.TRAINING
+            )
+            if state.world and self._node_rank in state.world:
+                ranks = sorted(state.world)
+                my_index = ranks.index(self._node_rank)
+                coordinator = self._agree_coordinator(
+                    state.round, my_index == 0
+                )
+                return {
+                    "round": state.round,
+                    "world": state.world,
+                    "rank": my_index,
+                    "coordinator": coordinator,
+                }
+            time.sleep(1.0)
+        raise TimeoutError(
+            f"rendezvous did not complete in {self._config.rdzv_timeout}s"
+        )
+
+    def _agree_coordinator(self, round_: int, am_rank0: bool) -> str:
+        """Rank 0 publishes host:port via master kv (ref ``training.py:413-430``
+        where rank-0 picks a free port and writes it to the store)."""
+        key = _COORD_PORT_KEY.format(round=round_)
+        if am_rank0:
+            from dlrover_tpu.master.messages import free_port
+            import socket
+
+            addr = f"{socket.gethostbyname(socket.gethostname())}:{free_port()}"
+            self._client.kv_put(key, addr.encode())
+            return addr
+        value = None
+        deadline = time.monotonic() + 60
+        while value is None and time.monotonic() < deadline:
+            value = self._client.kv_get(key)
+            if value is None:
+                time.sleep(0.5)
+        if value is None:
+            raise TimeoutError("coordinator address never published")
+        return value.decode()
+
+
+class ElasticAgent:
+    """Supervises one trainer subprocess; the restart-in-place state machine."""
+
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        entrypoint: List[str],
+        master_addr: str,
+        node_id: int = 0,
+    ):
+        self.config = config
+        self.entrypoint = entrypoint
+        self.master_addr = master_addr
+        self.node_id = node_id
+        self.client = MasterClient(master_addr, node_id=node_id)
+        self._rdzv = MasterRendezvousHandler(self.client, node_id, config)
+        self._proc: Optional[subprocess.Popen] = None
+        self._restart_count = 0
+        self._current_round = -1
+        self._stop = threading.Event()
+        self._saver: Optional[AsyncCheckpointSaver] = None
+        self._heartbeat_thread: Optional[threading.Thread] = None
+
+    # -- worker lifecycle -----------------------------------------------------
+
+    def _start_workers(self) -> Dict:
+        rdzv = self._rdzv.next_rendezvous()
+        self._current_round = rdzv["round"]
+        env = dict(os.environ)
+        env.update(
+            {
+                ENV_MASTER_ADDR: self.master_addr,
+                ENV_NODE_ID: str(self.node_id),
+                ENV_COORDINATOR: rdzv["coordinator"],
+                ENV_NUM_PROC: str(len(rdzv["world"])),
+                ENV_PROC_ID: str(rdzv["rank"]),
+                ENV_RESTART_COUNT: str(self._restart_count),
+            }
+        )
+        logger.info(
+            "starting trainer (round %d, rank %d/%d): %s",
+            rdzv["round"], rdzv["rank"], len(rdzv["world"]),
+            " ".join(self.entrypoint),
+        )
+        self._proc = subprocess.Popen(self.entrypoint, env=env)
+        self.client.report_event("started")
+        return rdzv
+
+    def _stop_workers(self, sig=signal.SIGTERM, grace: float = 30.0):
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        self._proc.send_signal(sig)
+        try:
+            self._proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            logger.warning("trainer ignored %s; killing", sig)
+            self._proc.kill()
+            self._proc.wait()
+
+    def _restart_workers(self):
+        """ref ``_restart_workers:687``: in-place process restart, no new pod."""
+        self._restart_count += 1
+        self._stop_workers()
+        self._start_workers()
+
+    def _membership_changed(self) -> bool:
+        """ref ``_membership_changed:694``: nodes waiting to join (scale-up)
+        or the formed world advanced past our round (a member left)."""
+        try:
+            waiting = self.client.num_nodes_waiting(RendezvousName.TRAINING)
+            return waiting > 0
+        except ConnectionError:
+            return False
+
+    # -- checkpoint hooks -----------------------------------------------------
+
+    def start_async_saver(self, num_hosts: int = 1):
+        if not self.config.checkpoint_dir:
+            return
+        self._saver = AsyncCheckpointSaver(
+            self.config.checkpoint_dir,
+            host_index=self.node_id,
+            num_hosts=num_hosts,
+        )
+        self._saver.start()
+        AsyncCheckpointSaver.register_signal_handlers()
+
+    def _save_ckpt_to_storage(self):
+        """ref ``_save_ckpt_to_storage:648`` (save_at_breakpoint): persist
+        whatever the dead trainer left in shm before restarting."""
+        if self._saver is not None and self.config.save_at_breakpoint:
+            self._saver.save_shm_to_storage()
+
+    # -- heartbeats -----------------------------------------------------------
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.client.report_heartbeat()
+            except ConnectionError:
+                logger.warning("heartbeat: master unreachable")
+            self._stop.wait(15.0)
+
+    # -- main loop ------------------------------------------------------------
+
+    def run(self) -> RunResult:
+        if self.config.network_check:
+            from dlrover_tpu.agent.node_check import run_network_check
+
+            ok = run_network_check(self.client, self.node_id)
+            if not ok:
+                self.client.report_failure(
+                    "network check failed", level="node"
+                )
+                return RunResult.FAILED
+        self.start_async_saver(num_hosts=self.config.max_nodes)
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, name="agent-heartbeat", daemon=True
+        )
+        self._heartbeat_thread.start()
+        self._start_workers()
+        result = self._invoke_run()
+        self._stop.set()
+        return result
+
+    def _invoke_run(self) -> RunResult:
+        while not self._stop.is_set():
+            time.sleep(self.config.monitor_interval)
+            code = self._proc.poll()
+            if code is None:
+                if self._membership_changed():
+                    logger.info("membership changed: restarting with new world")
+                    self.client.report_event("restarting", "membership change")
+                    self._restart_workers()
+                continue
+            if code == 0:
+                self.client.report_event("succeeded")
+                if self._saver is not None:
+                    # Drain pending persists before declaring success.
+                    time.sleep(1.0)
+                return RunResult.SUCCEEDED
+            # Failure path.
+            logger.error("trainer exited with code %d", code)
+            self._save_ckpt_to_storage()
+            try:
+                action = self.client.report_failure(
+                    f"exit code {code}",
+                    exit_code=code,
+                    level="process",
+                    restart_count=self._restart_count,
+                )
+            except ConnectionError:
+                action = (
+                    "restart"
+                    if self._restart_count < self.config.max_restarts
+                    else "stop"
+                )
+            if action == "restart" and (
+                self._restart_count < self.config.max_restarts
+            ):
+                self._restart_workers()
+                continue
+            self.client.report_event("failed", f"exit code {code}")
+            return RunResult.FAILED
+        self._stop_workers()
+        return RunResult.STOPPED
+
+    def shutdown(self, job_succeeded: bool = False):
+        self._stop.set()
+        self._stop_workers()
+        if self._saver is not None:
+            self._saver.stop(unlink_shm=job_succeeded)
+        self.client.close()

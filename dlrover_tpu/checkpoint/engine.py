@@ -25,7 +25,11 @@ import jax
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+)
 from dlrover_tpu.common.storage import (
     CheckpointDirLayout,
     CheckpointStorage,
@@ -59,6 +63,10 @@ def event_queue_name(host_index: int) -> str:
 
 def lock_name(host_index: int) -> str:
     return f"ckpt_lock_h{host_index}"
+
+
+def status_name(host_index: int) -> str:
+    return f"ckpt_status_h{host_index}"
 
 
 class CheckpointEngine:
@@ -99,7 +107,9 @@ class CheckpointEngine:
             event_queue_name(self.host_index), create=False
         )
         self._lock = SharedLock(lock_name(self.host_index), create=False)
+        self._status = SharedDict(status_name(self.host_index), create=False)
         self._latest_memory_step = -1
+        self._latest_storage_step = -1
 
     # -- save -----------------------------------------------------------------
 
@@ -129,6 +139,7 @@ class CheckpointEngine:
     ) -> bool:
         saved = self.save_to_memory(step, state, extra)
         if saved:
+            self._latest_storage_step = step
             self._event_queue.put(
                 CheckpointEvent(CheckpointEventType.SAVE, step)
             )
@@ -236,10 +247,22 @@ class CheckpointEngine:
         return state
 
     def wait_saver(self, timeout: float = 600.0):
-        """Block until the async saver drained all pending persists."""
+        """Block until every storage save this engine requested is durable.
+
+        Uses the saver's published progress (persisted/committed step), not
+        queue-emptiness — the queue is empty the instant the saver *pops* an
+        event, long before the bytes are on storage, and host 0's commit
+        barrier can run for minutes after its own persist.
+        """
+        target = self._latest_storage_step
+        if target < 0:
+            return True
+        # Host 0 must additionally wait for the cross-host commit.
+        key = "committed_step" if self.host_index == 0 else "persisted_step"
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self._event_queue.empty() and not self._lock.locked():
+            done = self._status.get(key, -1)
+            if done is not None and done >= target:
                 return True
             time.sleep(0.2)
         return False

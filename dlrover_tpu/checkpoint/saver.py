@@ -17,7 +17,11 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.common.multi_process import SharedLock, SharedQueue
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+)
 from dlrover_tpu.common.storage import (
     CheckpointDeletionStrategy,
     CheckpointDirLayout,
@@ -62,6 +66,10 @@ class AsyncCheckpointSaver:
             event_queue_name(host_index), create=True
         )
         self._lock = SharedLock(lock_name(host_index), create=True)
+        from dlrover_tpu.checkpoint.engine import status_name
+
+        self._status = SharedDict(status_name(host_index), create=True)
+        self._status.update({"persisted_step": -1, "committed_step": -1})
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self._persisted_step = -1
@@ -75,14 +83,17 @@ class AsyncCheckpointSaver:
         )
         self._thread.start()
 
-    def stop(self):
+    def stop(self, unlink_shm: bool = False):
+        """``unlink_shm=True`` only on clean job success — after a failure the
+        arena must survive for the save-at-breakpoint / resume path."""
         self._stopped.set()
         self._event_queue.put(CheckpointEvent(CheckpointEventType.EXIT))
         if self._thread:
             self._thread.join(timeout=10)
         self._event_queue.close()
         self._lock.close()
-        self._shm.close()
+        self._status.close()
+        self._shm.close(unlink=unlink_shm)
 
     @classmethod
     def register_signal_handlers(cls):
@@ -101,7 +112,13 @@ class AsyncCheckpointSaver:
                     saver.save_shm_to_storage()
                 except Exception as e:
                     logger.error("SIGTERM persist failed: %s", e)
-            signal.default_int_handler(signum, frame)
+            # Terminate with real SIGTERM semantics (not KeyboardInterrupt,
+            # which user code routinely catches): restore the default
+            # handler and re-deliver.
+            import os
+
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
 
         try:
             signal.signal(signal.SIGTERM, handler)
@@ -172,9 +189,10 @@ class AsyncCheckpointSaver:
             )
         finally:
             self._lock.release()
+        self._persisted_step = step
+        self._status.set("persisted_step", step)
         if self.host_index == 0:
             self.commit_checkpoint(step)
-        self._persisted_step = step
         return True
 
     def commit_checkpoint(self, step: int):
@@ -188,6 +206,7 @@ class AsyncCheckpointSaver:
             if done == self.num_hosts:
                 self.storage.write(str(step), self.layout.tracker_path())
                 self.storage.commit(step, True)
+                self._status.set("committed_step", step)
                 logger.info("committed step %d (%d hosts)", step, done)
                 self._clean_up(step)
                 return

@@ -156,7 +156,11 @@ class SharedMemoryHandler:
     """Owns one shm arena (per training process) and packs pytrees into it."""
 
     def __init__(self, name: str):
-        self.name = f"dlrover_tpu_ckpt_{name}".replace("/", "_")
+        import os
+
+        job = os.environ.get("DLROVER_TPU_JOB", "")
+        tag = f"{job}_" if job else ""
+        self.name = f"dlrover_tpu_ckpt_{tag}{name}".replace("/", "_")
         self._shm: Optional[SharedMemory] = None
 
     # -- writer side (trainer) ------------------------------------------------
@@ -170,16 +174,21 @@ class SharedMemoryHandler:
         total = data_offset + sum(b.nbytes for b in blocks)
         self._ensure_capacity(total)
         buf = self._shm.buf
-        buf[: _HEADER.size] = _HEADER.pack(len(meta_bytes))
-        buf[_HEADER.size : data_offset] = meta_bytes
+        # Crash-consistency ordering: invalidate the header first, then write
+        # data + meta, then publish the header *last*.  A trainer SIGKILLed
+        # mid-copy leaves meta_len == 0, which readers treat as "no
+        # checkpoint" instead of committing torn tensor bytes.
+        buf[: _HEADER.size] = _HEADER.pack(0)
+        blocks = iter(blocks)
         for tensor in meta.tensors:
             for record in tensor.shards:
                 start = data_offset + record.offset
                 dst = np.frombuffer(
                     buf, dtype=np.uint8, count=record.nbytes, offset=start
                 )
-                block = blocks.pop(0)
-                dst[:] = block.reshape(-1).view(np.uint8)
+                dst[:] = next(blocks).reshape(-1).view(np.uint8)
+        buf[_HEADER.size : data_offset] = meta_bytes
+        buf[: _HEADER.size] = _HEADER.pack(len(meta_bytes))
         return meta
 
     def _ensure_capacity(self, total: int):
@@ -203,6 +212,26 @@ class SharedMemoryHandler:
     # -- reader side (agent or restarted trainer) -----------------------------
 
     def attach(self) -> bool:
+        # The writer recreates (unlink + create, strictly larger) the arena
+        # when state grows; a reader holding the old mapping would silently
+        # read stale bytes forever.  Detect via the backing file's size and
+        # re-attach.
+        if self._shm is not None:
+            try:
+                import os
+
+                live_size = os.stat(f"/dev/shm/{self.name}").st_size
+            except FileNotFoundError:
+                self._shm.close()
+                self._shm = None
+                return False
+            if live_size != self._shm.size:
+                logger.info(
+                    "shm %s was recreated (%d -> %d bytes); re-attaching",
+                    self.name, self._shm.size, live_size,
+                )
+                self._shm.close()
+                self._shm = None
         if self._shm is None:
             self._shm = attach_or_none(self.name)
         return self._shm is not None

@@ -154,18 +154,26 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class SharedLock(LocalSocketComm):
-    """Cross-process non-reentrant lock (ref SharedLock semantics)."""
+    """Cross-process lock, reentrant per owner.
+
+    Every RPC is non-blocking at the server; client-side blocking acquire is
+    a poll loop.  This keeps each socket round-trip instant (no server thread
+    parked inside ``Lock.acquire`` racing the client's socket timeout) and
+    makes a lost response harmless: the retry from the same owner just
+    re-confirms ownership instead of deadlocking.
+    """
 
     def __init__(self, name: str, create: bool = False):
         self._lock = threading.Lock() if create else None
         self._owner: Optional[str] = None
         super().__init__("lock", name, create)
 
-    def _srv_acquire(self, blocking: bool, owner: str) -> bool:
-        got = self._lock.acquire(blocking=blocking, timeout=60 if blocking else -1)
+    def _srv_acquire(self, owner: str) -> bool:
+        got = self._lock.acquire(blocking=False)
         if got:
             self._owner = owner
-        return got
+            return True
+        return self._owner == owner  # reentrant / lost-response retry
 
     def _srv_release(self, owner: str) -> bool:
         if self._lock.locked():
@@ -177,11 +185,22 @@ class SharedLock(LocalSocketComm):
     def _srv_locked(self) -> bool:
         return self._lock.locked()
 
-    def acquire(self, blocking: bool = True) -> bool:
-        return self._call("acquire", blocking, f"{os.getpid()}")
+    def acquire(
+        self, blocking: bool = True, timeout: float = 600.0
+    ) -> bool:
+        owner = f"{os.getpid()}:{threading.get_ident()}"
+        if not blocking:
+            return self._call("acquire", owner)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._call("acquire", owner):
+                return True
+            time.sleep(0.05)
+        logger.warning("lock %s: blocking acquire timed out", self._name)
+        return False
 
     def release(self) -> bool:
-        return self._call("release", f"{os.getpid()}")
+        return self._call("release", f"{os.getpid()}:{threading.get_ident()}")
 
     def locked(self) -> bool:
         return self._call("locked")

@@ -39,14 +39,20 @@ class KeepLatestStepStrategy(CheckpointDeletionStrategy):
 
 
 class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
-    """Keep every ``keep_interval``-th step, delete the rest."""
+    """Keep every ``keep_interval``-th step plus the latest committed one.
+
+    The just-committed step is always retained (it is the resume point) —
+    off-interval steps are pruned when the *next* step commits.
+    """
 
     def __init__(self, keep_interval: int):
         self._keep_interval = keep_interval
+        self._pending: Optional[int] = None
 
     def clean_up(self, step: int, delete_fn) -> None:
-        if step % self._keep_interval:
-            delete_fn(step)
+        if self._pending is not None and self._pending % self._keep_interval:
+            delete_fn(self._pending)
+        self._pending = step
 
 
 class CheckpointStorage(ABC):

@@ -1,0 +1,168 @@
+"""Elastic host-side data pipeline: sampler + loader feeding the TPU mesh.
+
+Capability ref: ``dlrover/trainer/torch/elastic/sampler.py``
+(``ElasticDistributedSampler`` with checkpointable position) and
+``elastic/dataloader.py`` / ``atorch/data/elastic_dataset.py``.
+
+TPU shape of the problem: each host produces its *local slice* of the global
+batch; ``trainer.train_lib.shard_batch`` places it onto the mesh.  Two
+sourcing modes: a static checkpointable sampler (classic), or the master's
+dynamic sharding via ``ShardingClient`` (elastic — dead hosts' shards
+requeue automatically).
+"""
+
+from __future__ import annotations
+
+import threading
+import queue as _queue
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ElasticDistributedSampler:
+    """Deterministic rank-strided sampler with save/restore of position.
+
+    ``state_dict()`` records epoch + completed samples; after an elastic
+    resize, ``load_state_dict`` on the new world skips what was consumed —
+    semantics match ref ``ElasticDistributedSampler``.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.completed = 0  # globally-consumed samples this epoch
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.completed = 0
+
+    def __iter__(self) -> Iterator[int]:
+        order = np.arange(self.dataset_size)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        start = self.completed + self.rank
+        for i in range(start, self.dataset_size, self.num_replicas):
+            yield int(order[i])
+
+    def __len__(self) -> int:
+        remaining = self.dataset_size - self.completed
+        return max(0, remaining // self.num_replicas)
+
+    def record_batch(self, global_batch_size: int):
+        self.completed += global_batch_size
+
+    def state_dict(self) -> Dict:
+        return {"epoch": self.epoch, "completed": self.completed}
+
+    def load_state_dict(self, state: Dict):
+        self.epoch = state.get("epoch", 0)
+        self.completed = state.get("completed", 0)
+
+
+class ElasticDataLoader:
+    """Batched loader over ``sample_fn(index) -> dict[str, np.ndarray]``.
+
+    ``source`` is either an ``ElasticDistributedSampler`` or a
+    ``ShardingClient`` (dynamic mode).  Prefetches on a background thread so
+    host data prep overlaps device compute — the TPU input-pipeline pattern.
+    """
+
+    def __init__(
+        self,
+        sample_fn: Callable[[int], Dict[str, np.ndarray]],
+        batch_size: int,
+        source=None,
+        prefetch: int = 2,
+        drop_last: bool = True,
+    ):
+        self.sample_fn = sample_fn
+        self.batch_size = batch_size
+        self.source = source
+        self.prefetch = prefetch
+        self.drop_last = drop_last
+
+    def _index_stream(self) -> Iterator[int]:
+        from dlrover_tpu.data.sharding_client import ShardingClient
+
+        if self.source is None:
+            i = 0
+            while True:
+                yield i
+                i += 1
+        elif isinstance(self.source, ShardingClient):
+            yield from self.source.shard_indices()
+        else:
+            yield from iter(self.source)
+
+    def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        batch: List[Dict[str, np.ndarray]] = []
+        for index in self._index_stream():
+            batch.append(self.sample_fn(index))
+            if len(batch) == self.batch_size:
+                yield _collate(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield _collate(batch)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        q: _queue.Queue = _queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        error: List[BaseException] = []
+
+        def produce():
+            try:
+                for b in self._batches():
+                    q.put(b)
+            except BaseException as e:  # surfaced on the consumer side
+                error.append(e)
+            finally:
+                q.put(sentinel)
+
+        thread = threading.Thread(target=produce, daemon=True)
+        thread.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if error:
+                    raise error[0]
+                return
+            yield item
+
+
+def _collate(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    return {
+        key: np.stack([s[key] for s in samples]) for key in samples[0]
+    }
+
+
+def synthetic_lm_sample_fn(
+    vocab_size: int, seq_len: int, seed: int = 0
+) -> Callable[[int], Dict[str, np.ndarray]]:
+    """Deterministic synthetic LM data (bench + tests)."""
+
+    def sample(index: int) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(seed * 1_000_003 + index)
+        tokens = rng.integers(
+            0, vocab_size, size=(seq_len + 1,), dtype=np.int32
+        )
+        return {"inputs": tokens[:-1], "targets": tokens[1:]}
+
+    return sample

@@ -1,0 +1,135 @@
+"""Job master composition root + periodic control loop.
+
+Capability ref: ``dlrover/python/master/dist_master.py:86-304``
+(``prepare()``, 30s ``run()`` loop) and ``local_master.py`` (the standalone
+variant ``dlrover-run`` spawns when no cluster control plane exists).
+One class covers both here: the platform seam is the NodeLauncher.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.kv_store import KVStore
+from dlrover_tpu.master.node_manager import NodeLauncher, NodeManager
+from dlrover_tpu.master.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousName,
+)
+from dlrover_tpu.master.servicer import MasterServicer, start_master_server
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.task_manager import TaskManager
+
+
+class JobMaster:
+    CONTROL_LOOP_INTERVAL = 10.0
+
+    def __init__(
+        self,
+        port: int = 0,
+        num_nodes: int = 1,
+        node_unit: int = 1,
+        launcher: Optional[NodeLauncher] = None,
+        max_relaunches: int = 3,
+    ):
+        self.speed_monitor = SpeedMonitor()
+        self.task_manager = TaskManager()
+        self.kv_store = KVStore()
+        self.node_manager = NodeManager(
+            num_nodes=num_nodes,
+            launcher=launcher,
+            max_relaunches=max_relaunches,
+        )
+        elastic = ElasticTrainingRendezvousManager()
+        netcheck = NetworkCheckRendezvousManager()
+        for manager in (elastic, netcheck):
+            manager.update_rdzv_params(
+                min_nodes=num_nodes, max_nodes=num_nodes,
+                waiting_timeout=60.0, node_unit=node_unit,
+            )
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: elastic,
+            RendezvousName.NETWORK_CHECK: netcheck,
+        }
+        self.servicer = MasterServicer(
+            rdzv_managers=self.rdzv_managers,
+            task_manager=self.task_manager,
+            node_manager=self.node_manager,
+            speed_monitor=self.speed_monitor,
+            kv_store=self.kv_store,
+        )
+        self._server = None
+        self.port = port
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+
+    def prepare(self):
+        self._server, self.port = start_master_server(self.servicer, self.port)
+
+    def start(self):
+        if self._server is None:
+            self.prepare()
+        self._loop_thread = threading.Thread(
+            target=self._control_loop, name="master-loop", daemon=True
+        )
+        self._loop_thread.start()
+        return self.port
+
+    def _control_loop(self):
+        """ref ``dist_master.py:211-269``: periodic health/housekeeping."""
+        while not self._stop.is_set():
+            try:
+                self.node_manager.check_heartbeats()
+                self.task_manager.reassign_timeout_tasks()
+            except Exception as e:
+                logger.warning("master control loop error: %s", e)
+            self._stop.wait(self.CONTROL_LOOP_INTERVAL)
+
+    def stop(self):
+        self._stop.set()
+        if self._loop_thread:
+            self._loop_thread.join(timeout=5)
+        if self._server:
+            self._server.stop(grace=1).wait()
+            self._server = None
+
+    def run_forever(self):
+        """Block until the job ends (all nodes succeeded or job failed)."""
+        try:
+            while not self._stop.is_set():
+                if self.node_manager.job_failed:
+                    logger.error(
+                        "job failed: %s", self.node_manager.job_failure_reason
+                    )
+                    return 1
+                if self.node_manager.all_succeeded():
+                    logger.info("job succeeded")
+                    return 0
+                time.sleep(2)
+        finally:
+            self.stop()
+        return 0
+
+
+def main():  # python -m dlrover_tpu.master.job_master --port N --nodes N
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--nodes", type=int, default=1)
+    parser.add_argument("--node-unit", type=int, default=1)
+    args = parser.parse_args()
+    master = JobMaster(
+        port=args.port, num_nodes=args.nodes, node_unit=args.node_unit
+    )
+    master.start()
+    print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)
+    raise SystemExit(master.run_forever())
+
+
+if __name__ == "__main__":
+    main()

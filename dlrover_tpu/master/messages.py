@@ -1,0 +1,231 @@
+"""Typed control-plane messages multiplexed over the 2-RPC master service.
+
+Capability ref: ``dlrover/python/common/grpc.py`` (dataclass-serialized
+messages inside ``Master.report``/``Master.get``,
+``dlrover/proto/elastic_training.proto:26-28``).  The envelope identifies the
+sender (TPU host) and the payload class selects the handler — adding a message
+type never changes the wire contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Envelope:
+    """Wrapper for every request: which host, which job, what payload."""
+
+    node_id: int = -1
+    node_type: str = "worker"
+    job_name: str = "local"
+    payload: Any = None
+
+
+@dataclasses.dataclass
+class Response:
+    success: bool = True
+    payload: Any = None
+    message: str = ""
+
+
+# -- rendezvous --------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JoinRendezvous:
+    node_rank: int
+    local_world_size: int
+    rdzv_name: str = "elastic-training"
+    node_unit: int = 1
+
+
+@dataclasses.dataclass
+class RendezvousState:
+    round: int = 0
+    group: int = 0
+    world: Dict[int, int] = dataclasses.field(default_factory=dict)
+    waiting: int = 0
+
+
+@dataclasses.dataclass
+class CommWorldRequest:
+    node_rank: int
+    rdzv_name: str = "elastic-training"
+
+
+@dataclasses.dataclass
+class NetworkStatus:
+    node_rank: int
+    normal: bool
+    elapsed: float
+
+
+@dataclasses.dataclass
+class NetworkCheckResult:
+    fault_nodes: List[int] = dataclasses.field(default_factory=list)
+    stragglers: List[int] = dataclasses.field(default_factory=list)
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class WaitingNodesRequest:
+    rdzv_name: str = "elastic-training"
+
+
+# -- data sharding -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DatasetShardParams:
+    dataset_name: str
+    dataset_size: int
+    shard_size: int
+    num_epochs: int = 1
+    shuffle: bool = False
+    storage_type: str = "table"  # table | text | stream
+    batch_size: int = 0
+
+
+@dataclasses.dataclass
+class ShardTask:
+    task_id: int = -1
+    dataset_name: str = ""
+    start: int = 0
+    end: int = 0
+    epoch: int = 0
+    record_indices: Optional[List[int]] = None
+
+    @property
+    def empty(self) -> bool:
+        return self.task_id < 0
+
+
+@dataclasses.dataclass
+class TaskRequest:
+    dataset_name: str
+    node_id: int = -1
+
+
+@dataclasses.dataclass
+class TaskResult:
+    task_id: int
+    dataset_name: str
+    success: bool = True
+
+
+@dataclasses.dataclass
+class ShardCheckpointRequest:
+    dataset_name: str
+
+
+@dataclasses.dataclass
+class ShardCheckpoint:
+    dataset_name: str
+    content: str  # json
+
+
+# -- kv store ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KVPut:
+    key: str
+    value: bytes
+
+
+@dataclasses.dataclass
+class KVGet:
+    key: str
+
+
+@dataclasses.dataclass
+class KVAdd:
+    key: str
+    amount: int = 1
+
+
+# -- telemetry / lifecycle ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class StepReport:
+    step: int
+    timestamp: float = dataclasses.field(default_factory=time.time)
+    samples: int = 0
+    tokens: int = 0
+    loss: float = 0.0
+
+
+@dataclasses.dataclass
+class HeartBeat:
+    node_id: int
+    timestamp: float = dataclasses.field(default_factory=time.time)
+    diagnosis: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class NodeFailure:
+    node_id: int
+    error: str = ""
+    exit_code: int = 0
+    restart_count: int = 0
+    level: str = "process"  # process | node | job
+
+
+@dataclasses.dataclass
+class NodeEventReport:
+    node_id: int
+    event: str  # started | succeeded | failed | preempting
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class ResourceStats:
+    node_id: int
+    cpu_percent: float = 0.0
+    mem_gb: float = 0.0
+    device_mem_gb: float = 0.0
+    device_util: float = 0.0
+
+
+@dataclasses.dataclass
+class JobStatusRequest:
+    pass
+
+
+@dataclasses.dataclass
+class JobStatus:
+    speed: float = 0.0
+    global_step: int = 0
+    nodes: Dict[int, str] = dataclasses.field(default_factory=dict)
+    goodput: float = 0.0
+
+
+@dataclasses.dataclass
+class ParalConfigRequest:
+    node_id: int
+
+
+@dataclasses.dataclass
+class ParalConfig:
+    """Runtime-tunable knobs pushed master -> trainer (ref ParalConfigTuner)."""
+
+    global_batch_size: int = 0
+    grad_accum: int = 1
+    version: int = 0
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def free_port(start: int = 20000, end: int = 40000) -> int:
+    for port in range(start, end, 7):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(("", port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError("no free port found")

@@ -1,0 +1,188 @@
+"""Node (TPU host) lifecycle: inventory, heartbeats, relaunch decisions.
+
+Capability ref: ``dlrover/python/master/node/dist_job_manager.py:88-864``
+(``_monitor_node_heart_beat:355``, ``_process_event:473``,
+``_should_relaunch:561``, ``_relaunch_node:605``) and the event callbacks
+(``node/event_callback.py``: recover shards / reset speed on node death).
+
+TPU redesign: the schedulable unit is a host (TPU VM) and elasticity is
+slice-granular.  Actual pod/VM creation sits behind the ``NodeLauncher``
+seam (mirroring the reference's Scaler/Watcher seam) so unit tests and the
+local standalone mode need no cloud API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class NodeStatus(str, Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    PREEMPTING = "preempting"
+    DEAD = "dead"
+
+
+class ExceptionLevel(str, Enum):
+    PROCESS = "process"  # restart training processes in place
+    NODE = "node"        # relaunch the host/slice
+    JOB = "job"          # unrecoverable: fail the job
+
+
+class NodeState:
+    def __init__(self, node_id: int, max_relaunches: int = 3):
+        self.node_id = node_id
+        self.status = NodeStatus.PENDING
+        self.last_heartbeat = time.time()
+        self.relaunch_count = 0
+        self.max_relaunches = max_relaunches
+        self.exit_code = 0
+        self.error = ""
+
+
+class NodeLauncher:
+    """Platform seam: create/delete TPU hosts. Local/test impls are no-ops
+    or subprocess spawns; the GKE impl talks to the cloud API."""
+
+    def launch(self, node_id: int) -> None:
+        logger.info("launcher: (noop) launch node %d", node_id)
+
+    def delete(self, node_id: int) -> None:
+        logger.info("launcher: (noop) delete node %d", node_id)
+
+
+class NodeManager:
+    HEARTBEAT_TIMEOUT = 300.0
+
+    def __init__(
+        self,
+        num_nodes: int = 1,
+        launcher: Optional[NodeLauncher] = None,
+        max_relaunches: int = 3,
+    ):
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, NodeState] = {
+            i: NodeState(i, max_relaunches) for i in range(num_nodes)
+        }
+        self._launcher = launcher or NodeLauncher()
+        self._max_relaunches = max_relaunches
+        # Event callbacks: fn(node_id, old_status, new_status).
+        self._callbacks: List[Callable[[int, NodeStatus, NodeStatus], None]] = []
+        self.job_failed = False
+        self.job_failure_reason = ""
+
+    def add_callback(self, fn: Callable[[int, NodeStatus, NodeStatus], None]):
+        self._callbacks.append(fn)
+
+    def _transition(self, node: NodeState, status: NodeStatus):
+        old = node.status
+        if old == status:
+            return
+        node.status = status
+        logger.info("node %d: %s -> %s", node.node_id, old.value, status.value)
+        for fn in self._callbacks:
+            try:
+                fn(node.node_id, old, status)
+            except Exception as e:
+                logger.warning("node callback failed: %s", e)
+
+    def ensure_node(self, node_id: int) -> NodeState:
+        if node_id not in self._nodes:
+            self._nodes[node_id] = NodeState(node_id, self._max_relaunches)
+        return self._nodes[node_id]
+
+    def report_event(self, node_id: int, event: str, detail: str = ""):
+        with self._lock:
+            node = self.ensure_node(node_id)
+            node.last_heartbeat = time.time()
+            mapping = {
+                "started": NodeStatus.RUNNING,
+                "succeeded": NodeStatus.SUCCEEDED,
+                "failed": NodeStatus.FAILED,
+                "preempting": NodeStatus.PREEMPTING,
+            }
+            if event in mapping:
+                self._transition(node, mapping[event])
+            if event == "failed":
+                node.error = detail
+                self._maybe_relaunch(node)
+
+    def report_heartbeat(self, node_id: int, timestamp: float):
+        with self._lock:
+            node = self.ensure_node(node_id)
+            node.last_heartbeat = timestamp
+            if node.status == NodeStatus.PENDING:
+                self._transition(node, NodeStatus.RUNNING)
+
+    def report_failure(
+        self, node_id: int, error: str, exit_code: int, level: str
+    ) -> str:
+        """Returns the action the agent should take: restart|relaunch|stop."""
+        with self._lock:
+            node = self.ensure_node(node_id)
+            node.error = error
+            node.exit_code = exit_code
+            if level == ExceptionLevel.JOB:
+                self.job_failed = True
+                self.job_failure_reason = error
+                return "stop"
+            if level == ExceptionLevel.NODE:
+                self._transition(node, NodeStatus.FAILED)
+                return (
+                    "relaunch" if self._maybe_relaunch(node) else "stop"
+                )
+            # process-level: agent restarts workers in place; node stays up.
+            node.relaunch_count += 1
+            if node.relaunch_count > node.max_relaunches:
+                self.job_failed = True
+                self.job_failure_reason = (
+                    f"node {node_id} exceeded {node.max_relaunches} restarts"
+                )
+                return "stop"
+            return "restart"
+
+    def _maybe_relaunch(self, node: NodeState) -> bool:
+        """ref ``_should_relaunch:561``: relaunch unless budget exhausted or
+        the failure is fatal (exit code classified as unrecoverable)."""
+        if node.relaunch_count >= node.max_relaunches:
+            self.job_failed = True
+            self.job_failure_reason = (
+                f"node {node.node_id} exceeded relaunch budget"
+            )
+            return False
+        node.relaunch_count += 1
+        self._launcher.delete(node.node_id)
+        self._launcher.launch(node.node_id)
+        self._transition(node, NodeStatus.PENDING)
+        return True
+
+    def check_heartbeats(self) -> List[int]:
+        """Mark hosts with stale heartbeats dead; returns newly-dead ids
+        (ref ``_monitor_node_heart_beat:355``, 300s window)."""
+        newly_dead = []
+        now = time.time()
+        with self._lock:
+            for node in self._nodes.values():
+                if node.status in (NodeStatus.RUNNING, NodeStatus.PREEMPTING):
+                    if now - node.last_heartbeat > self.HEARTBEAT_TIMEOUT:
+                        self._transition(node, NodeStatus.DEAD)
+                        newly_dead.append(node.node_id)
+                        self._maybe_relaunch(node)
+        return newly_dead
+
+    def statuses(self) -> Dict[int, str]:
+        with self._lock:
+            return {i: n.status.value for i, n in self._nodes.items()}
+
+    def all_succeeded(self) -> bool:
+        with self._lock:
+            return all(
+                n.status == NodeStatus.SUCCEEDED for n in self._nodes.values()
+            )

@@ -1,0 +1,234 @@
+"""The single gRPC surface of the master: two RPCs, ~20 typed messages.
+
+Capability ref: ``dlrover/python/master/servicer.py:71-668`` and
+``dlrover/proto/elastic_training.proto:26-28`` (``Master.report`` fire-and-
+forget + ``Master.get`` query, dataclass payloads inside).  We keep the same
+2-RPC shape but skip protoc entirely: grpc generic handlers with pickled
+dataclass envelopes — adding a message type is adding a dataclass + a
+dispatch entry, no codegen step.
+"""
+
+from __future__ import annotations
+
+import pickle
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional, Type
+
+import grpc
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master import messages as msg
+
+SERVICE = "dlrover_tpu.Master"
+REPORT = f"/{SERVICE}/report"
+GET = f"/{SERVICE}/get"
+
+
+class MasterServicer:
+    """Dispatches report/get payloads to the master components."""
+
+    def __init__(
+        self,
+        rdzv_managers=None,
+        task_manager=None,
+        node_manager=None,
+        speed_monitor=None,
+        kv_store=None,
+        paral_config=None,
+    ):
+        self.rdzv_managers = rdzv_managers or {}
+        self.task_manager = task_manager
+        self.node_manager = node_manager
+        self.speed_monitor = speed_monitor
+        self.kv_store = kv_store
+        self.paral_config = paral_config or msg.ParalConfig()
+        self._get_handlers: Dict[Type, Callable] = {
+            msg.CommWorldRequest: self._get_comm_world,
+            msg.WaitingNodesRequest: self._get_waiting_nodes,
+            msg.TaskRequest: self._get_task,
+            msg.KVGet: self._kv_get,
+            msg.KVAdd: self._kv_add,
+            msg.ShardCheckpointRequest: self._get_shard_checkpoint,
+            msg.JobStatusRequest: self._get_job_status,
+            msg.ParalConfigRequest: self._get_paral_config,
+        }
+        self._report_handlers: Dict[Type, Callable] = {
+            msg.JoinRendezvous: self._join_rendezvous,
+            msg.NetworkStatus: self._report_network_status,
+            msg.DatasetShardParams: self._create_dataset,
+            msg.TaskResult: self._report_task_result,
+            msg.KVPut: self._kv_put,
+            msg.StepReport: self._report_step,
+            msg.HeartBeat: self._report_heartbeat,
+            msg.NodeFailure: self._report_failure,
+            msg.NodeEventReport: self._report_event,
+            msg.ResourceStats: self._report_resource,
+            msg.ShardCheckpoint: self._restore_shard_checkpoint,
+        }
+
+    # -- RPC entry points -----------------------------------------------------
+
+    def report(self, envelope: msg.Envelope) -> msg.Response:
+        handler = self._report_handlers.get(type(envelope.payload))
+        if handler is None:
+            return msg.Response(
+                False, message=f"no handler for {type(envelope.payload)}"
+            )
+        try:
+            result = handler(envelope)
+            return msg.Response(True, payload=result)
+        except Exception as e:
+            logger.exception("report handler failed")
+            return msg.Response(False, message=str(e))
+
+    def get(self, envelope: msg.Envelope) -> msg.Response:
+        handler = self._get_handlers.get(type(envelope.payload))
+        if handler is None:
+            return msg.Response(
+                False, message=f"no handler for {type(envelope.payload)}"
+            )
+        try:
+            return msg.Response(True, payload=handler(envelope))
+        except Exception as e:
+            logger.exception("get handler failed")
+            return msg.Response(False, message=str(e))
+
+    # -- rendezvous -----------------------------------------------------------
+
+    def _join_rendezvous(self, env: msg.Envelope):
+        p: msg.JoinRendezvous = env.payload
+        manager = self.rdzv_managers[p.rdzv_name]
+        if p.node_unit > 1:
+            manager._node_unit = p.node_unit
+        return manager.join_rendezvous(p.node_rank, p.local_world_size)
+
+    def _get_comm_world(self, env: msg.Envelope):
+        p: msg.CommWorldRequest = env.payload
+        manager = self.rdzv_managers[p.rdzv_name]
+        round_, group, world = manager.get_comm_world(p.node_rank)
+        return msg.RendezvousState(
+            round=round_, group=group, world=world,
+            waiting=manager.num_nodes_waiting(),
+        )
+
+    def _get_waiting_nodes(self, env: msg.Envelope):
+        manager = self.rdzv_managers[env.payload.rdzv_name]
+        return manager.num_nodes_waiting()
+
+    def _report_network_status(self, env: msg.Envelope):
+        p: msg.NetworkStatus = env.payload
+        manager = self.rdzv_managers.get("network-check")
+        if manager is not None:
+            manager.report_network_status(p.node_rank, p.normal, p.elapsed)
+
+    # -- data sharding --------------------------------------------------------
+
+    def _create_dataset(self, env: msg.Envelope):
+        self.task_manager.create_dataset(env.payload)
+
+    def _get_task(self, env: msg.Envelope):
+        p: msg.TaskRequest = env.payload
+        node = p.node_id if p.node_id >= 0 else env.node_id
+        return self.task_manager.get_task(p.dataset_name, node)
+
+    def _report_task_result(self, env: msg.Envelope):
+        p: msg.TaskResult = env.payload
+        return self.task_manager.report_task(
+            p.dataset_name, p.task_id, p.success
+        )
+
+    def _get_shard_checkpoint(self, env: msg.Envelope):
+        return self.task_manager.checkpoint(env.payload.dataset_name)
+
+    def _restore_shard_checkpoint(self, env: msg.Envelope):
+        self.task_manager.restore(env.payload)
+
+    # -- kv store -------------------------------------------------------------
+
+    def _kv_put(self, env: msg.Envelope):
+        self.kv_store.put(env.payload.key, env.payload.value)
+
+    def _kv_get(self, env: msg.Envelope):
+        return self.kv_store.get(env.payload.key)
+
+    def _kv_add(self, env: msg.Envelope):
+        return self.kv_store.add(env.payload.key, env.payload.amount)
+
+    # -- telemetry / lifecycle ------------------------------------------------
+
+    def _report_step(self, env: msg.Envelope):
+        p: msg.StepReport = env.payload
+        self.speed_monitor.collect_global_step(p.step, p.timestamp, p.tokens)
+
+    def _report_heartbeat(self, env: msg.Envelope):
+        p: msg.HeartBeat = env.payload
+        if self.node_manager:
+            self.node_manager.report_heartbeat(p.node_id, p.timestamp)
+
+    def _report_failure(self, env: msg.Envelope):
+        p: msg.NodeFailure = env.payload
+        for manager in self.rdzv_managers.values():
+            manager.remove_alive_node(p.node_id)
+        if self.task_manager:
+            self.task_manager.recover_tasks(p.node_id)
+        if self.speed_monitor:
+            self.speed_monitor.reset_running_speed()
+        if self.node_manager:
+            return self.node_manager.report_failure(
+                p.node_id, p.error, p.exit_code, p.level
+            )
+        return "restart"
+
+    def _report_event(self, env: msg.Envelope):
+        p: msg.NodeEventReport = env.payload
+        if self.node_manager:
+            self.node_manager.report_event(p.node_id, p.event, p.detail)
+
+    def _report_resource(self, env: msg.Envelope):
+        pass  # recorded by metric collector (auto-scaler input)
+
+    def _get_job_status(self, env: msg.Envelope):
+        return msg.JobStatus(
+            speed=self.speed_monitor.running_speed() if self.speed_monitor else 0.0,
+            global_step=self.speed_monitor.global_step if self.speed_monitor else 0,
+            nodes=self.node_manager.statuses() if self.node_manager else {},
+            goodput=self.speed_monitor.goodput() if self.speed_monitor else 0.0,
+        )
+
+    def _get_paral_config(self, env: msg.Envelope):
+        return self.paral_config
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, servicer: MasterServicer):
+        self._servicer = servicer
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == REPORT:
+            fn = self._servicer.report
+        elif method == GET:
+            fn = self._servicer.get
+        else:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            lambda request, context: fn(request),
+            request_deserializer=pickle.loads,
+            response_serializer=pickle.dumps,
+        )
+
+
+def start_master_server(
+    servicer: MasterServicer, port: int = 0, max_workers: int = 32
+):
+    """Returns (grpc.Server, bound_port)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="master-rpc"
+        )
+    )
+    server.add_generic_rpc_handlers((_GenericHandler(servicer),))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    logger.info("master gRPC server on port %d", bound)
+    return server, bound

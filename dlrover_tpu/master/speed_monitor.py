@@ -1,0 +1,92 @@
+"""Throughput tracking + goodput accounting.
+
+Capability ref: ``dlrover/python/master/monitor/speed_monitor.py:43-186``
+(``collect_global_step``, ``running_speed``).  Extended with the goodput
+ledger the north-star metric needs: wall-clock is classified into productive
+(steps advancing) vs lost (init/restart/hang) time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+
+class SpeedMonitor:
+    SAMPLE_WINDOW = 20
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: Deque[Tuple[float, int, int]] = deque(
+            maxlen=self.SAMPLE_WINDOW
+        )  # (ts, global_step, tokens_cum)
+        self._global_step = 0
+        self._tokens_cum = 0
+        self._start_time = time.time()
+        self._productive_s = 0.0
+        self._last_step_time: Optional[float] = None
+        self._first_step_time: Optional[float] = None
+
+    def collect_global_step(
+        self, step: int, timestamp: Optional[float] = None, tokens: int = 0
+    ):
+        ts = timestamp or time.time()
+        with self._lock:
+            if step <= self._global_step:
+                return
+            if self._last_step_time is not None:
+                # Time between consecutive step reports counts as productive
+                # as long as steps keep advancing.
+                self._productive_s += ts - self._last_step_time
+            else:
+                self._first_step_time = ts
+            self._last_step_time = ts
+            self._global_step = step
+            self._tokens_cum += tokens
+            self._samples.append((ts, step, self._tokens_cum))
+
+    def reset_running_speed(self):
+        """Call on restart: the gap until the next step report is downtime."""
+        with self._lock:
+            self._samples.clear()
+            self._last_step_time = None
+
+    @property
+    def global_step(self) -> int:
+        return self._global_step
+
+    def running_speed(self) -> float:
+        """Steps/sec over the sample window."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            (t0, s0, _), (t1, s1, _) = self._samples[0], self._samples[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    def token_throughput(self) -> float:
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            (t0, _, k0), (t1, _, k1) = self._samples[0], self._samples[-1]
+            if t1 <= t0:
+                return 0.0
+            return (k1 - k0) / (t1 - t0)
+
+    def goodput(self) -> float:
+        """productive_time / total_time since the job began (0..1)."""
+        with self._lock:
+            total = time.time() - self._start_time
+            if total <= 0:
+                return 0.0
+            return min(1.0, self._productive_s / total)
+
+    def no_progress_for(self) -> float:
+        """Seconds since the last step advance (hang detection input)."""
+        with self._lock:
+            if self._last_step_time is None:
+                return time.time() - self._start_time
+            return time.time() - self._last_step_time

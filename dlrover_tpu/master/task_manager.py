@@ -1,0 +1,269 @@
+"""Dynamic data sharding: split datasets into shards, reassign on failure.
+
+Capability ref: ``dlrover/python/master/shard/task_manager.py:37-292`` +
+``shard/dataset_splitter.py`` (``TableDatasetSplitter``,
+``TextDatasetSplitter``, ``StreamingDatasetSplitter``) +
+``batch_dataset_manager.py`` (pending/doing queues, ``recover_tasks``,
+timeout reassignment, shard checkpoint/restore).
+
+The design carries over cleanly to TPU training because it is pure host-side
+control plane: shards are [start, end) ranges of a global sample index space;
+the trainer's per-host dataloader asks for the next shard instead of using a
+static partition, so a resized world automatically rebalances and a dead
+host's in-flight shards requeue.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.messages import (
+    DatasetShardParams,
+    ShardCheckpoint,
+    ShardTask,
+)
+
+_TASK_TIMEOUT = 1800.0
+
+
+class DatasetSplitter:
+    """Produces epoch-by-epoch lists of shards."""
+
+    def __init__(self, params: DatasetShardParams):
+        self.params = params
+        self.epoch = 0
+
+    def create_shards(self) -> List[ShardTask]:
+        p = self.params
+        shards = []
+        num = (p.dataset_size + p.shard_size - 1) // p.shard_size
+        order = list(range(num))
+        if p.shuffle:
+            import random
+
+            random.Random(self.epoch).shuffle(order)
+        for i in order:
+            start = i * p.shard_size
+            end = min(start + p.shard_size, p.dataset_size)
+            shards.append(
+                ShardTask(
+                    dataset_name=p.dataset_name,
+                    start=start,
+                    end=end,
+                    epoch=self.epoch,
+                )
+            )
+        self.epoch += 1
+        return shards
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.params.num_epochs
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Unbounded stream: keeps emitting fixed-size shards forever
+    (capability ref ``dataset_splitter.py:359`` StreamingDatasetSplitter)."""
+
+    def __init__(self, params: DatasetShardParams):
+        super().__init__(params)
+        self._next_start = 0
+
+    def create_shards(self) -> List[ShardTask]:
+        p = self.params
+        shards = []
+        for _ in range(64):  # refill window
+            shards.append(
+                ShardTask(
+                    dataset_name=p.dataset_name,
+                    start=self._next_start,
+                    end=self._next_start + p.shard_size,
+                    epoch=0,
+                )
+            )
+            self._next_start += p.shard_size
+        return shards
+
+    def epoch_finished(self) -> bool:
+        return False
+
+
+def make_splitter(params: DatasetShardParams) -> DatasetSplitter:
+    if params.storage_type == "stream":
+        return StreamingDatasetSplitter(params)
+    return DatasetSplitter(params)
+
+
+class DatasetManager:
+    def __init__(self, splitter: DatasetSplitter):
+        self.splitter = splitter
+        self.pending: Deque[ShardTask] = deque()
+        self.doing: "OrderedDict[int, Tuple[int, ShardTask, float]]" = (
+            OrderedDict()
+        )
+        self._next_task_id = 0
+        self._completed = 0
+
+    def refill_if_empty(self):
+        if not self.pending and not self.splitter.epoch_finished():
+            for shard in self.splitter.create_shards():
+                shard.task_id = self._next_task_id
+                self._next_task_id += 1
+                self.pending.append(shard)
+
+    def get_task(self, node_id: int) -> ShardTask:
+        self.refill_if_empty()
+        if not self.pending:
+            return ShardTask()  # empty: dataset exhausted
+        task = self.pending.popleft()
+        self.doing[task.task_id] = (node_id, task, time.monotonic())
+        return task
+
+    def report_task(self, task_id: int, success: bool) -> bool:
+        entry = self.doing.pop(task_id, None)
+        if entry is None:
+            return False
+        if success:
+            self._completed += 1
+        else:
+            self.pending.appendleft(entry[1])
+        return True
+
+    def recover_tasks(self, node_id: int):
+        """Requeue all in-flight shards of a dead host (ref
+        ``task_manager.recover_tasks:165``)."""
+        requeued = []
+        for task_id, (owner, task, _) in list(self.doing.items()):
+            if owner == node_id:
+                del self.doing[task_id]
+                self.pending.appendleft(task)
+                requeued.append(task_id)
+        if requeued:
+            logger.info(
+                "requeued %d shards of dead node %d", len(requeued), node_id
+            )
+
+    def reassign_timeout_tasks(self, timeout: float = _TASK_TIMEOUT):
+        now = time.monotonic()
+        for task_id, (owner, task, started) in list(self.doing.items()):
+            if now - started > timeout:
+                del self.doing[task_id]
+                self.pending.appendleft(task)
+                logger.warning(
+                    "shard %d timed out on node %d; requeued", task_id, owner
+                )
+
+    def finished(self) -> bool:
+        return (
+            not self.pending
+            and not self.doing
+            and self.splitter.epoch_finished()
+        )
+
+    def checkpoint(self) -> Dict:
+        """Uncompleted = pending + doing; both restart from scratch on resume
+        (ref ``task_manager.get_dataset_checkpoint:243``)."""
+        todo = [
+            (t.start, t.end, t.epoch)
+            for t in list(self.pending)
+            + [task for _, task, _ in self.doing.values()]
+        ]
+        return {
+            "dataset": self.splitter.params.dataset_name,
+            "todo": todo,
+            "epoch": self.splitter.epoch,
+            "completed": self._completed,
+        }
+
+    def restore(self, state: Dict):
+        self.pending.clear()
+        self.doing.clear()
+        for start, end, epoch in state.get("todo", []):
+            shard = ShardTask(
+                task_id=self._next_task_id,
+                dataset_name=self.splitter.params.dataset_name,
+                start=start,
+                end=end,
+                epoch=epoch,
+            )
+            self._next_task_id += 1
+            self.pending.append(shard)
+        self.splitter.epoch = state.get("epoch", 0)
+        self._completed = state.get("completed", 0)
+
+
+class TaskManager:
+    """All datasets of one job + the timeout-reassignment loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._worker_last_report: Dict[int, float] = {}
+
+    def create_dataset(self, params: DatasetShardParams):
+        with self._lock:
+            if params.dataset_name not in self._datasets:
+                self._datasets[params.dataset_name] = DatasetManager(
+                    make_splitter(params)
+                )
+                logger.info(
+                    "created dataset %s: size=%d shard=%d epochs=%d",
+                    params.dataset_name, params.dataset_size,
+                    params.shard_size, params.num_epochs,
+                )
+
+    def get_task(self, dataset_name: str, node_id: int) -> ShardTask:
+        with self._lock:
+            manager = self._datasets.get(dataset_name)
+            if manager is None:
+                return ShardTask()
+            self._worker_last_report[node_id] = time.monotonic()
+            return manager.get_task(node_id)
+
+    def report_task(
+        self, dataset_name: str, task_id: int, success: bool
+    ) -> bool:
+        with self._lock:
+            manager = self._datasets.get(dataset_name)
+            return manager.report_task(task_id, success) if manager else False
+
+    def recover_tasks(self, node_id: int):
+        with self._lock:
+            for manager in self._datasets.values():
+                manager.recover_tasks(node_id)
+
+    def reassign_timeout_tasks(self):
+        with self._lock:
+            for manager in self._datasets.values():
+                manager.reassign_timeout_tasks()
+
+    def finished(self, dataset_name: str) -> bool:
+        with self._lock:
+            manager = self._datasets.get(dataset_name)
+            return manager.finished() if manager else True
+
+    def checkpoint(self, dataset_name: str) -> ShardCheckpoint:
+        with self._lock:
+            manager = self._datasets.get(dataset_name)
+            content = json.dumps(manager.checkpoint()) if manager else "{}"
+            return ShardCheckpoint(dataset_name, content)
+
+    def restore(self, ckpt: ShardCheckpoint):
+        with self._lock:
+            manager = self._datasets.get(ckpt.dataset_name)
+            if manager and ckpt.content:
+                manager.restore(json.loads(ckpt.content))
+
+    def worker_progressing(self, window: float = 1800.0) -> bool:
+        """Any shard-fetch activity inside the hang-detection window?"""
+        with self._lock:
+            if not self._worker_last_report:
+                return True
+            return (
+                time.monotonic() - max(self._worker_last_report.values())
+                < window
+            )

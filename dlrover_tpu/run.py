@@ -1,0 +1,119 @@
+"""``dlrover-tpu-run`` — the elastic launcher CLI.
+
+Capability ref: ``dlrover/trainer/torch/elastic_run.py:124-388``
+(``dlrover-run``: standalone local master spawn, master ping, agent launch)
+and its flag surface (``--network-check``, ``--max-restarts``, node counts).
+
+Usage::
+
+    python -m dlrover_tpu.run --standalone -- python train.py
+    python -m dlrover_tpu.run --master host:port --nnodes 4 --node-id 2 \
+        --network-check -- python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.agent.training_agent import (
+    ElasticAgent,
+    ElasticLaunchConfig,
+    RunResult,
+)
+
+
+def _parse_args(argv: Optional[List[str]] = None):
+    parser = argparse.ArgumentParser(prog="dlrover-tpu-run")
+    parser.add_argument(
+        "--standalone", action="store_true",
+        help="run an in-process master (single-host jobs, no control plane)",
+    )
+    parser.add_argument("--master", default="", help="master host:port")
+    parser.add_argument("--nnodes", default="1",
+                        help="N or MIN:MAX elastic range of TPU hosts")
+    parser.add_argument("--node-id", type=int,
+                        default=int(os.environ.get("TPU_WORKER_ID", 0)))
+    parser.add_argument("--node-unit", type=int, default=1,
+                        help="world size must be a multiple of this (slice size)")
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--monitor-interval", type=float, default=5.0)
+    parser.add_argument("--network-check", action="store_true")
+    parser.add_argument("--save-at-breakpoint", action="store_true")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- trainer command")
+    args = parser.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        parser.error("no trainer command given (use: ... -- python train.py)")
+    return args
+
+
+def _parse_nnodes(spec: str) -> Tuple[int, int]:
+    if ":" in spec:
+        lo, hi = spec.split(":")
+        return int(lo), int(hi)
+    n = int(spec)
+    return n, n
+
+
+def _launch_local_master(num_nodes: int, node_unit: int):
+    """Standalone mode: in-process master (ref
+    ``_launch_dlrover_local_master`` ``elastic_run.py:344-351``)."""
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(
+        port=0, num_nodes=num_nodes, node_unit=node_unit
+    )
+    port = master.start()
+    return master, f"localhost:{port}"
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    args = _parse_args(argv)
+    min_nodes, max_nodes = _parse_nnodes(args.nnodes)
+    local_master = None
+    if args.standalone or not args.master:
+        local_master, master_addr = _launch_local_master(
+            max_nodes, args.node_unit
+        )
+        logger.info("standalone master at %s", master_addr)
+    else:
+        master_addr = args.master
+    config = ElasticLaunchConfig(
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        node_unit=args.node_unit,
+        max_restarts=args.max_restarts,
+        monitor_interval=args.monitor_interval,
+        network_check=args.network_check,
+        save_at_breakpoint=args.save_at_breakpoint,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    agent = ElasticAgent(
+        config, args.command, master_addr, node_id=args.node_id
+    )
+    result = RunResult.FAILED
+    try:
+        result = agent.run()
+    finally:
+        agent.shutdown(job_succeeded=result == RunResult.SUCCEEDED)
+        if local_master is not None:
+            local_master.stop()
+    logger.info("job finished: %s", result.value)
+    return 0 if result == RunResult.SUCCEEDED else 1
+
+
+def main():
+    raise SystemExit(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Trainer-side runtime bootstrap: consume the agent's environment contract.
+
+The inverse of ``dlrover_tpu.agent.training_agent``: the agent rendezvouses
+with the master and exports coordinator/world env vars; the trainer calls
+``initialize()`` here to join the jax multi-controller world and get its
+master client (for data sharding, step reporting, kv barriers).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.agent.training_agent import (
+    ENV_COORDINATOR,
+    ENV_MASTER_ADDR,
+    ENV_NODE_ID,
+    ENV_NUM_PROC,
+    ENV_PROC_ID,
+    ENV_RESTART_COUNT,
+)
+
+
+def under_agent() -> bool:
+    return ENV_COORDINATOR in os.environ
+
+
+def process_id() -> int:
+    return int(os.environ.get(ENV_PROC_ID, 0))
+
+
+def num_processes() -> int:
+    return int(os.environ.get(ENV_NUM_PROC, 1))
+
+
+def restart_count() -> int:
+    return int(os.environ.get(ENV_RESTART_COUNT, 0))
+
+
+def node_id() -> int:
+    return int(os.environ.get(ENV_NODE_ID, 0))
+
+
+def initialize(force: bool = False):
+    """Join the multi-host jax world the agent rendezvoused for us.
+
+    No-op for single-host jobs (jax initializes locally).  Safe to call
+    unconditionally at the top of a training script.
+    """
+    if not under_agent():
+        logger.info("no agent environment; single-process jax")
+        return
+    n = num_processes()
+    if n <= 1 and not force:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ[ENV_COORDINATOR],
+        num_processes=n,
+        process_id=process_id(),
+    )
+    logger.info(
+        "joined jax world: process %d/%d (coordinator %s)",
+        process_id(), n, os.environ[ENV_COORDINATOR],
+    )
+
+
+def master_client(node_type: str = "worker"):
+    """The trainer's MasterClient, or None when running without a master."""
+    addr = os.environ.get(ENV_MASTER_ADDR, "")
+    if not addr:
+        return None
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    return MasterClient(addr, node_id=node_id(), node_type=node_type)

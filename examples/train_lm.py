@@ -1,0 +1,152 @@
+"""End-to-end elastic LM training example (nanoGPT-scale).
+
+The TPU-native counterpart of the reference's flagship example
+(ref ``examples/pytorch/nanogpt/train.py`` + ``dlrover-run``): launch with
+
+    python -m dlrover_tpu.run --standalone -- python examples/train_lm.py \
+        --steps 50 --checkpoint-dir /tmp/ckpt
+
+Demonstrates the full loop: agent rendezvous env, mesh + sharded train step,
+dynamic data sharding from the master, step reporting (speed/goodput), flash
+checkpointing every N steps, and crash-resume (restart picks up from the
+latest checkpoint and the shard stream continues where it left off).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--ckpt-every", type=int, default=10)
+    p.add_argument("--dataset-size", type=int, default=100000)
+    p.add_argument("--fail-at-step", type=int, default=0,
+                   help="test hook: crash at this step on first run")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    from dlrover_tpu.common.log import default_logger as logger
+    from dlrover_tpu.runtime import env as renv
+    from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+    from dlrover_tpu.parallel import rules as lr
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.models.transformer import TransformerLM
+    from dlrover_tpu.trainer import train_lib
+    from dlrover_tpu.data.loader import ElasticDataLoader, synthetic_lm_sample_fn
+    from dlrover_tpu.data.sharding_client import ShardingClient
+
+    renv.initialize()
+    client = renv.master_client()
+
+    cfg = gpt2_config(
+        "124m",
+        num_layers=args.layers,
+        d_model=args.d_model,
+        num_heads=args.heads,
+        vocab_size=args.vocab,
+        max_seq_len=args.seq_len,
+    )
+    mesh = build_mesh(ParallelConfig(data=-1))
+    model = TransformerLM(cfg)
+    opt = train_lib.make_optimizer(learning_rate=1e-3)
+    train = train_lib.build_sharded_train(
+        model, opt, mesh, lr.DEFAULT_RULES,
+        global_batch_size=args.batch_size, seq_len=args.seq_len,
+    )
+    state = train.init(jax.random.PRNGKey(0))
+
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from dlrover_tpu.checkpoint import Checkpointer, StorageType
+
+        # Agent runs the saver when launched via dlrover-tpu-run
+        # (--checkpoint-dir); otherwise run it in-process.
+        ckpt = Checkpointer(
+            args.checkpoint_dir,
+            local_saver=not renv.under_agent(),
+        )
+        step, restored = ckpt.load_checkpoint(
+            shardings=train.state_shardings, state_template=state
+        )
+        if restored is not None:
+            state = restored
+            start_step = step
+            logger.info("resumed from checkpoint at step %d", step)
+
+    if client is not None:
+        loader_source = ShardingClient(
+            client,
+            "train",
+            dataset_size=args.dataset_size,
+            shard_size=args.batch_size * 8,
+            num_epochs=8,
+            create=True,
+        )
+    else:
+        loader_source = None
+    loader = ElasticDataLoader(
+        synthetic_lm_sample_fn(args.vocab, args.seq_len),
+        batch_size=args.batch_size,
+        source=loader_source,
+    )
+
+    step = start_step
+    t_start = time.monotonic()
+    for batch in loader:
+        if step >= args.steps:
+            break
+        placed = train_lib.shard_batch(batch, train)
+        state, metrics = train.step(state, placed)
+        step += 1
+        if args.fail_at_step and step == args.fail_at_step:
+            if renv.restart_count() == 0:
+                logger.error("test hook: crashing at step %d", step)
+                os._exit(17)
+        if step % 5 == 0 or step == args.steps:
+            loss = float(metrics["loss"])
+            logger.info("step %d loss %.4f", step, loss)
+            if client is not None:
+                client.report_step(
+                    step,
+                    tokens=args.batch_size * args.seq_len * 5,
+                    loss=loss,
+                )
+        if ckpt is not None and (
+            step % args.ckpt_every == 0 or step == args.steps
+        ):
+            from dlrover_tpu.checkpoint import StorageType
+
+            ckpt.save_checkpoint(step, state, StorageType.DISK)
+    elapsed = time.monotonic() - t_start
+    tokens = (step - start_step) * args.batch_size * args.seq_len
+    logger.info(
+        "done: %d steps (%.1f tokens/s)", step,
+        tokens / elapsed if elapsed > 0 else 0.0,
+    )
+    if ckpt is not None:
+        ckpt.wait(timeout=120)
+        ckpt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,15 @@ def test_shared_queue_lock_dict_cross_object(tmp_path):
     server_l = mp_ipc.SharedLock("l1", create=True)
     client_l = mp_ipc.SharedLock("l1", create=False)
     assert client_l.acquire()
-    assert not server_l.acquire(blocking=False)
+    # Reentrant for the same owner (lost-response retries must not deadlock).
+    assert client_l.acquire(blocking=False)
+    # Contended from a *different* thread -> refused.
+    from_other: list = []
+    t = threading.Thread(
+        target=lambda: from_other.append(server_l.acquire(blocking=False))
+    )
+    t.start(); t.join()
+    assert from_other == [False]
     assert client_l.release()
     assert server_l.acquire(blocking=False)
     server_l.release()
@@ -171,6 +179,38 @@ def test_deletion_strategies(tmp_path):
     for s in [50, 100, 150, 200]:
         keep_interval.clean_up(s, deleted.append)
     assert deleted == [50, 150]
+
+
+def test_reader_reattaches_after_arena_growth():
+    """Saver must not keep reading a stale mapping after the trainer
+    recreates a larger arena (state grew between steps)."""
+    name = f"g{os.getpid()}"
+    writer = SharedMemoryHandler(name)
+    writer.save_state_dict({"w": np.ones(8, np.float32)}, step=1)
+    reader = SharedMemoryHandler(name)
+    assert reader.load_meta().step == 1
+    # Grow past the arena size -> writer unlinks + recreates.
+    big = {"w": np.ones(1 << 19, np.float32), "v": np.ones(1 << 19)}
+    writer.save_state_dict(big, step=2)
+    meta = reader.load_meta()
+    assert meta is not None and meta.step == 2
+    writer.close(unlink=True)
+    reader.close()
+
+
+def test_torn_write_is_invisible():
+    """A crash mid-save must not leave a valid-looking checkpoint: the
+    header is zeroed during the write and only published at the end."""
+    handler = SharedMemoryHandler(f"torn{os.getpid()}")
+    handler.save_state_dict({"w": np.ones(4, np.float32)}, step=1)
+
+    # Simulate death mid-write: corrupt by zeroing the header the way
+    # save_state_dict does before copying blocks.
+    import struct
+
+    handler._shm.buf[:8] = struct.pack("<Q", 0)
+    assert handler.load_meta() is None
+    handler.close(unlink=True)
 
 
 def test_saver_sigterm_persist_path(tmp_path):

@@ -61,7 +61,7 @@ def main() -> None:
         max_seq_len=SEQ_LEN,
         param_dtype=jnp.bfloat16,
         remat="full",
-        attention_impl="xla",
+        attention_impl="flash",
     )
     model = TransformerLM(config)
     mesh = build_mesh(ParallelConfig(data=-1, fsdp=1))

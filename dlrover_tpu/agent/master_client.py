@@ -51,12 +51,12 @@ class MasterClient:
         self._report = self._channel.unary_unary(
             REPORT,
             request_serializer=pickle.dumps,
-            response_deserializer=pickle.loads,
+            response_deserializer=msg.safe_loads,
         )
         self._get = self._channel.unary_unary(
             GET,
             request_serializer=pickle.dumps,
-            response_deserializer=pickle.loads,
+            response_deserializer=msg.safe_loads,
         )
 
     def _envelope(self, payload) -> msg.Envelope:
@@ -66,11 +66,21 @@ class MasterClient:
 
     @retry
     def report(self, payload) -> msg.Response:
-        return self._report(self._envelope(payload), timeout=30)
+        response = self._report(self._envelope(payload), timeout=30)
+        if not response.success:
+            raise RuntimeError(
+                f"master rejected {type(payload).__name__}: {response.message}"
+            )
+        return response
 
     @retry
     def get(self, payload) -> msg.Response:
-        return self._get(self._envelope(payload), timeout=30)
+        response = self._get(self._envelope(payload), timeout=30)
+        if not response.success:
+            raise RuntimeError(
+                f"master failed {type(payload).__name__}: {response.message}"
+            )
+        return response
 
     def ping(self, timeout: float = 2.0) -> bool:
         try:
@@ -109,6 +119,9 @@ class MasterClient:
         self, node_rank: int, normal: bool, elapsed: float
     ):
         self.report(msg.NetworkStatus(node_rank, normal, elapsed))
+
+    def get_network_check_result(self) -> msg.NetworkCheckResult:
+        return self.get(msg.NetworkCheckResultRequest(self.node_id)).payload
 
     # -- data sharding --------------------------------------------------------
 

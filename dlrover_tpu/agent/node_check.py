@@ -107,27 +107,36 @@ def run_network_check(
     """Drive the check rounds against the master; returns node health.
 
     ref ``training.py:1054-1118``: each round joins the network-check
-    rendezvous, runs the probe, reports status+elapsed, and asks the master
-    for the fault verdict; round 2 re-pairs suspects (master side).
+    rendezvous, runs the probe, reports status+elapsed; after the final
+    round the *master's* pairwise-bisection verdict decides health.  A node
+    whose own probe failed still joins every round — dropping out would
+    stall the remaining nodes' rendezvous and starve the bisection of the
+    suspect it needs to re-pair.
     """
     from dlrover_tpu.master.rdzv_manager import RendezvousName
 
+    local_healthy = True
     for check_round in range(rounds):
-        client.join_rendezvous(
-            node_rank, 1, RendezvousName.NETWORK_CHECK
-        )
+        client.join_rendezvous(node_rank, 1, RendezvousName.NETWORK_CHECK)
         deadline = time.monotonic() + timeout
-        world = {}
         while time.monotonic() < deadline:
             state = client.get_comm_world(
                 node_rank, RendezvousName.NETWORK_CHECK
             )
             if state.world:
-                world = state.world
                 break
             time.sleep(0.5)
         healthy, elapsed = run_probe_payload()
+        local_healthy = local_healthy and healthy
         client.report_network_status(node_rank, healthy, elapsed)
-        if not healthy:
-            return False
-    return True
+
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        result = client.get_network_check_result()
+        if result.reason == "done":
+            if result.stragglers:
+                logger.warning("straggler nodes: %s", result.stragglers)
+            return node_rank not in result.fault_nodes
+        time.sleep(1.0)
+    logger.warning("network-check verdict timed out; using local result")
+    return local_healthy

@@ -40,6 +40,27 @@ ENV_RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
 _COORD_PORT_KEY = "rdzv/coordinator/{round}"
 
 
+def _routable_ip(master_addr: str) -> str:
+    """This host's IP as seen on the route to the master.
+
+    ``gethostbyname(gethostname())`` commonly yields 127.0.1.1 (Debian-style
+    /etc/hosts), which other hosts cannot dial; the connected-UDP trick asks
+    the kernel for the interface actually used to reach the cluster.
+    """
+    import socket
+
+    host = master_addr.rsplit(":", 1)[0] or "localhost"
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((host, 1))
+            ip = s.getsockname()[0]
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return socket.gethostbyname(socket.gethostname())
+
+
 @dataclasses.dataclass
 class ElasticLaunchConfig:
     """ref ``ElasticLaunchConfig`` ``training.py:112-162``."""
@@ -107,9 +128,8 @@ class MasterRendezvousHandler:
         key = _COORD_PORT_KEY.format(round=round_)
         if am_rank0:
             from dlrover_tpu.master.messages import free_port
-            import socket
 
-            addr = f"{socket.gethostbyname(socket.gethostname())}:{free_port()}"
+            addr = f"{_routable_ip(self._client._addr)}:{free_port()}"
             self._client.kv_put(key, addr.encode())
             return addr
         value = None
@@ -167,6 +187,10 @@ class ElasticAgent:
             rdzv["round"], rdzv["rank"], len(rdzv["world"]),
             " ".join(self.entrypoint),
         )
+        if self._saver is not None:
+            # The commit barrier counts done-files of the *sealed* world, not
+            # max_nodes — an elastic world of 3/4 hosts must still commit.
+            self._saver.num_hosts = len(rdzv["world"])
         self._proc = subprocess.Popen(self.entrypoint, env=env)
         self.client.report_event("started")
         return rdzv

@@ -96,55 +96,112 @@ class ElasticDataLoader:
         self.prefetch = prefetch
         self.drop_last = drop_last
 
-    def _index_stream(self) -> Iterator[int]:
+    def _indexed_stream(self) -> Iterator:
+        """Yields (index, completed_shards) — shards listed once all their
+        indices have been emitted."""
         from dlrover_tpu.data.sharding_client import ShardingClient
 
         if self.source is None:
             i = 0
             while True:
-                yield i
+                yield i, []
                 i += 1
         elif isinstance(self.source, ShardingClient):
-            yield from self.source.shard_indices()
+            while True:
+                task = self.source.fetch_shard()
+                if task is None:
+                    return
+                for index in range(task.start, task.end - 1):
+                    yield index, []
+                yield task.end - 1, [task]
         else:
-            yield from iter(self.source)
+            for index in self.source:
+                yield index, []
 
-    def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _batches(self) -> Iterator:
+        """Yields (collated_batch, completed_shards)."""
         batch: List[Dict[str, np.ndarray]] = []
-        for index in self._index_stream():
+        done: List = []
+        for index, completed in self._indexed_stream():
             batch.append(self.sample_fn(index))
+            done.extend(completed)
             if len(batch) == self.batch_size:
-                yield _collate(batch)
-                batch = []
+                yield _collate(batch), done
+                batch, done = [], []
         if batch and not self.drop_last:
-            yield _collate(batch)
+            yield _collate(batch), done
+
+    def _ack(self, shards):
+        for shard in shards:
+            self.source.report_shard_done(shard)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Shard-ack contract: a shard is acked only once the consumer has
+        come back for the batch *after* the one that finished it — i.e. the
+        covering batch was actually handed to (and presumably trained by)
+        the caller, not merely prefetched.  A crash mid-batch leaves its
+        shards unacked, so the master requeues them (at-least-once)."""
         if self.prefetch <= 0:
-            yield from self._batches()
+            pending: List = []
+            for batch, done in self._batches():
+                self._ack(pending)
+                pending = done
+                yield batch
+            self._ack(pending)
             return
+
         q: _queue.Queue = _queue.Queue(maxsize=self.prefetch)
         sentinel = object()
+        stop = threading.Event()
         error: List[BaseException] = []
+
+        def put_retrying(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
-                for b in self._batches():
-                    q.put(b)
+                for item in self._batches():
+                    if not put_retrying(item):
+                        return
             except BaseException as e:  # surfaced on the consumer side
                 error.append(e)
             finally:
-                q.put(sentinel)
+                # The sentinel must use the same stop-aware retry: dropping
+                # it on a full queue would strand the consumer in q.get().
+                put_retrying(sentinel)
 
         thread = threading.Thread(target=produce, daemon=True)
         thread.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                if error:
-                    raise error[0]
-                return
-            yield item
+        pending = []
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if error:
+                        raise error[0]
+                    self._ack(pending)
+                    return
+                batch, done = item
+                self._ack(pending)
+                pending = done
+                yield batch
+        finally:
+            # Consumer abandoned the iterator (break) or finished: stop the
+            # producer so it doesn't park in q.put forever. Unacked shards
+            # requeue via the master's timeout reassignment.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            thread.join(timeout=2.0)
 
 
 def _collate(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

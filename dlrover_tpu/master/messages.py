@@ -10,6 +10,7 @@ type never changes the wire contract.
 from __future__ import annotations
 
 import dataclasses
+import pickle
 import socket
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -74,6 +75,11 @@ class NetworkCheckResult:
 @dataclasses.dataclass
 class WaitingNodesRequest:
     rdzv_name: str = "elastic-training"
+
+
+@dataclasses.dataclass
+class NetworkCheckResultRequest:
+    node_rank: int = -1
 
 
 # -- data sharding -----------------------------------------------------------
@@ -218,6 +224,45 @@ class ParalConfig:
     grad_accum: int = 1
     version: int = 0
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Deserializer for the control-plane wire format.
+
+    gRPC payloads are pickled dataclasses; vanilla ``pickle.loads`` on a
+    network port is arbitrary code execution.  Restrict resolvable globals
+    to this package's message/dataclass types and a small builtin set, so a
+    crafted payload can at worst construct our own message objects.
+    """
+
+    _SAFE_BUILTINS = {
+        "dict", "list", "tuple", "set", "frozenset", "bytes", "str",
+        "int", "float", "complex", "bool", "NoneType", "bytearray",
+    }
+
+    def find_class(self, module: str, name: str):
+        # Dotted names are attribute chains (STACK_GLOBAL resolves
+        # 'subprocess.Popen' relative to any allowed module) — reject them,
+        # and allow only top-level classes of this exact module.
+        if "." in name:
+            raise pickle.UnpicklingError(
+                f"forbidden dotted global {module}.{name}"
+            )
+        if module == __name__:
+            value = globals().get(name)
+            if isinstance(value, type):
+                return value
+        if module == "builtins" and name in self._SAFE_BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden global {module}.{name} in control-plane payload"
+        )
+
+
+def safe_loads(data: bytes):
+    import io
+
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
 
 
 def free_port(start: int = 20000, end: int = 40000) -> int:

@@ -51,6 +51,7 @@ class MasterServicer:
             msg.ShardCheckpointRequest: self._get_shard_checkpoint,
             msg.JobStatusRequest: self._get_job_status,
             msg.ParalConfigRequest: self._get_paral_config,
+            msg.NetworkCheckResultRequest: self._get_network_check_result,
         }
         self._report_handlers: Dict[Type, Callable] = {
             msg.JoinRendezvous: self._join_rendezvous,
@@ -120,6 +121,17 @@ class MasterServicer:
         manager = self.rdzv_managers.get("network-check")
         if manager is not None:
             manager.report_network_status(p.node_rank, p.normal, p.elapsed)
+
+    def _get_network_check_result(self, env: msg.Envelope):
+        manager = self.rdzv_managers.get("network-check")
+        if manager is None:
+            return msg.NetworkCheckResult(reason="done")
+        faults, reason = manager.check_fault_node()
+        return msg.NetworkCheckResult(
+            fault_nodes=faults,
+            stragglers=manager.get_stragglers(),
+            reason=reason,
+        )
 
     # -- data sharding --------------------------------------------------------
 
@@ -213,7 +225,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return None
         return grpc.unary_unary_rpc_method_handler(
             lambda request, context: fn(request),
-            request_deserializer=pickle.loads,
+            request_deserializer=msg.safe_loads,
             response_serializer=pickle.dumps,
         )
 

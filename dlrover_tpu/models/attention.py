@@ -128,29 +128,44 @@ class Attention(nn.Module):
         if self.use_rope:
             q, k = layers.rotary_embedding(q, k, positions, self.rope_theta)
 
-        # Ulysses boundary: reshard seq-split -> head-split (a2a under SP).
-        attn_spec = (lr.BATCH, None, lr.ACT_HEADS, lr.KV)
-        q = nn.with_logical_constraint(q, attn_spec)
-        k = nn.with_logical_constraint(k, attn_spec)
-        v = nn.with_logical_constraint(v, attn_spec)
+        if self.attention_impl == "ring":
+            # Ring CP: sequence stays sharded; K/V stream around the ring.
+            from dlrover_tpu.parallel.ring_attention import ring_attention
 
-        if self.attention_impl == "flash":
-            from dlrover_tpu.ops import flash_attention as fa
-
-            out = fa.mha(
-                q, k, v,
-                causal=True,
-                segment_ids=segment_ids,
-                block_q=self.flash_block_q,
-                block_kv=self.flash_block_kv,
-            )
-        elif self.attention_impl == "xla":
-            out = xla_attention(q, k, v, causal=True, segment_ids=segment_ids)
+            spec = (lr.BATCH, lr.ACT_SEQ, lr.ACT_HEADS, lr.KV)
+            q = nn.with_logical_constraint(q, spec)
+            k = nn.with_logical_constraint(k, spec)
+            v = nn.with_logical_constraint(v, spec)
+            out = ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
+            out = nn.with_logical_constraint(out, spec)
         else:
-            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+            # Ulysses boundary: reshard seq-split -> head-split (a2a under SP).
+            attn_spec = (lr.BATCH, None, lr.ACT_HEADS, lr.KV)
+            q = nn.with_logical_constraint(q, attn_spec)
+            k = nn.with_logical_constraint(k, attn_spec)
+            v = nn.with_logical_constraint(v, attn_spec)
 
-        # Ulysses boundary back: head-split -> seq-split.
-        out = nn.with_logical_constraint(out, attn_spec)
+            if self.attention_impl == "flash":
+                from dlrover_tpu.ops import flash_attention as fa
+
+                out = fa.mha(
+                    q, k, v,
+                    causal=True,
+                    segment_ids=segment_ids,
+                    block_q=self.flash_block_q,
+                    block_kv=self.flash_block_kv,
+                )
+            elif self.attention_impl == "xla":
+                out = xla_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids
+                )
+            else:
+                raise ValueError(
+                    f"unknown attention_impl {self.attention_impl!r}"
+                )
+
+            # Ulysses boundary back: head-split -> seq-split.
+            out = nn.with_logical_constraint(out, attn_spec)
         out = layers.DenseGeneral(
             features,
             axis=(-2, -1),

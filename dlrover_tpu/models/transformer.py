@@ -69,9 +69,9 @@ class TransformerConfig:
         return self.head_dim or self.d_model // self.num_heads
 
     def __post_init__(self):
-        if self.attention_impl not in ("xla", "flash"):
+        if self.attention_impl not in ("xla", "flash", "ring"):
             raise ValueError(
-                f"attention_impl must be 'xla' or 'flash', got "
+                f"attention_impl must be 'xla', 'flash' or 'ring', got "
                 f"{self.attention_impl!r}"
             )
         if self.remat not in _REMAT_POLICIES:

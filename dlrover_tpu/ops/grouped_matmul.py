@@ -1,0 +1,173 @@
+"""Grouped (ragged) matmul kernel: per-expert GEMM for dropless MoE.
+
+Capability ref: ``atorch/atorch/modules/moe/grouped_gemm_moe.py:46``
+(``Grouped_GEMM_MoE`` batching per-expert GEMMs into one kernel).
+
+``x`` rows are sorted by expert; ``group_sizes[e]`` rows belong to expert
+``e`` and multiply ``w[e]``.  The row->expert mapping is data-dependent, so
+the expert index for each row block is computed on device (searchsorted over
+the group offsets) and fed to the kernel through scalar prefetch, where the
+*index maps* use it to stream the right expert's weights — the Pallas TPU
+pattern for ragged work (PrefetchScalarGridSpec).
+
+Group sizes must be multiples of ``block_rows``; the MoE layer guarantees
+this by padding each expert's token group (capacity-style or to the block).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _gmm_kernel(expert_of_block, x_ref, w_ref, out_ref):
+    out_ref[:] = jax.lax.dot(
+        x_ref[:], w_ref[0], preferred_element_type=jnp.float32
+    ).astype(out_ref.dtype)
+
+
+def _expert_of_block(group_sizes, num_blocks, block_rows):
+    offsets = jnp.cumsum(group_sizes)
+    block_starts = jnp.arange(num_blocks, dtype=jnp.int32) * block_rows
+    return jnp.searchsorted(offsets, block_starts, side="right").astype(
+        jnp.int32
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def grouped_matmul(
+    x: jax.Array,           # [N, K] rows sorted by expert
+    w: jax.Array,           # [E, K, M]
+    group_sizes: jax.Array, # [E] int32, sum == N, multiples of block_rows
+    block_rows: int = 128,
+) -> jax.Array:
+    """Returns [N, M] where out[r] = x[r] @ w[expert_of_row(r)]."""
+    return _gmm_fwd_impl(x, w, group_sizes, block_rows)
+
+
+def _gmm_fwd_impl(x, w, group_sizes, block_rows):
+    n, k = x.shape
+    e, _, m = w.shape
+    assert n % block_rows == 0, f"N={n} not a multiple of {block_rows}"
+    num_blocks = n // block_rows
+    expert_of_block = _expert_of_block(group_sizes, num_blocks, block_rows)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, k), lambda i, eob: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, k, m), lambda i, eob: (eob[i], 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, m), lambda i, eob: (i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    )
+    return pl.pallas_call(
+        _gmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, m), x.dtype),
+        interpret=_interpret(),
+    )(expert_of_block, x, w)
+
+
+def _gmm_dw_kernel(eob_ref, x_ref, dy_ref, dw_ref, acc_ref):
+    """Accumulate x_block^T @ dy_block into the owning expert's dw.
+
+    Row blocks of one expert are consecutive (rows sorted by expert), so the
+    expert's output block stays resident across its run of grid steps; the
+    accumulator resets at each expert boundary.
+    """
+    i = pl.program_id(0)
+    first = jnp.logical_or(i == 0, eob_ref[i] != eob_ref[jnp.maximum(i - 1, 0)])
+    last = jnp.logical_or(
+        i == pl.num_programs(0) - 1,
+        eob_ref[i] != eob_ref[jnp.minimum(i + 1, pl.num_programs(0) - 1)],
+    )
+
+    @pl.when(first)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:], dy_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(last)
+    def _():
+        dw_ref[0] = acc_ref[:].astype(dw_ref.dtype)
+
+
+def _gmm_fwd(x, w, group_sizes, block_rows):
+    out = _gmm_fwd_impl(x, w, group_sizes, block_rows)
+    return out, (x, w, group_sizes)
+
+
+def _gmm_bwd(block_rows, residuals, dy):
+    x, w, group_sizes = residuals
+    n, k = x.shape
+    e, _, m = w.shape
+    num_blocks = n // block_rows
+    # dx: grouped matmul against w^T.
+    dx = _gmm_fwd_impl(
+        dy, jnp.swapaxes(w, 1, 2), group_sizes, block_rows
+    ).astype(x.dtype)
+    # dw: per-expert accumulation over that expert's row blocks.
+    eob = _expert_of_block(group_sizes, num_blocks, block_rows)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, k), lambda i, eob: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_rows, m), lambda i, eob: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, k, m), lambda i, eob: (eob[i], 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[pltpu.VMEM((k, m), jnp.float32)],
+    )
+    dw = pl.pallas_call(
+        _gmm_dw_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((e, k, m), w.dtype),
+        interpret=_interpret(),
+    )(eob, x, dy)
+    # Experts with no rows are never visited; their dw block is undefined.
+    dw = jnp.where((group_sizes > 0)[:, None, None], dw, 0.0).astype(w.dtype)
+    return dx, dw, None
+
+
+grouped_matmul.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def grouped_matmul_ref(x, w, group_sizes):
+    """XLA reference used in tests and as the CPU fallback."""
+    offsets = jnp.cumsum(group_sizes)
+    experts = jnp.searchsorted(
+        offsets, jnp.arange(x.shape[0]), side="right"
+    )
+    return jnp.einsum("nk,nkm->nm", x, w[experts])

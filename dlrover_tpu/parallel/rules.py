@@ -62,6 +62,7 @@ def make_rules(
     sequence: bool = True,
     expert: bool = True,
     pipeline: bool = False,
+    context: str = "ulysses",
 ) -> List[Tuple[str, MeshAxes]]:
     """Build the rule table for a strategy combination.
 
@@ -69,6 +70,11 @@ def make_rules(
     size 1 (the sharding becomes a no-op), so the default is "everything on"
     and the mesh shape alone decides the real strategy — mirroring how
     ``auto_accelerate`` composes optimizations without code changes.
+
+    ``context`` picks the sequence-parallel style inside attention:
+    ``"ulysses"`` reshards seq->heads at attention boundaries (a2a);
+    ``"ring"`` keeps the sequence sharded and the ring_attention impl
+    streams K/V over the seq axis (pair with ``attention_impl="ring"``).
     """
     rules: List[Tuple[str, MeshAxes]] = [
         (BATCH, (DATA_AXIS, FSDP_AXIS)),
@@ -77,12 +83,17 @@ def make_rules(
         (NORM, None),
     ]
     rules.append((ACT_SEQ, SEQ_AXIS if sequence else None))
-    # Ulysses: heads sharded over the seq (and tensor) axes inside attention,
-    # letting XLA introduce the seq<->heads all-to-all at attention boundaries.
-    rules.append(
-        (ACT_HEADS, ((SEQ_AXIS, TENSOR_AXIS) if sequence else TENSOR_AXIS)
-         if tensor or sequence else None)
-    )
+    if context == "ring":
+        # Ring CP: heads stay tensor-sharded; sequence stays seq-sharded.
+        rules.append((ACT_HEADS, TENSOR_AXIS if tensor else None))
+    else:
+        # Ulysses: heads sharded over the seq (and tensor) axes inside
+        # attention, letting XLA introduce the seq<->heads all-to-all at
+        # attention boundaries.
+        rules.append(
+            (ACT_HEADS, ((SEQ_AXIS, TENSOR_AXIS) if sequence else TENSOR_AXIS)
+             if tensor or sequence else None)
+        )
     rules.append((EMBED, FSDP_AXIS if fsdp else None))
     if tensor:
         rules += [(MLP, TENSOR_AXIS), (HEADS, TENSOR_AXIS), (VOCAB, TENSOR_AXIS)]
@@ -100,3 +111,6 @@ DEFAULT_RULES: List[Tuple[str, MeshAxes]] = make_rules()
 DDP_RULES: List[Tuple[str, MeshAxes]] = make_rules(
     fsdp=False, tensor=False, sequence=False, expert=False
 )
+
+# Ring context-parallelism: pair with TransformerConfig.attention_impl="ring".
+RING_RULES: List[Tuple[str, MeshAxes]] = make_rules(context="ring")

@@ -136,7 +136,10 @@ def _sanitize_boxes(tree):
         if isinstance(leaf, nn.meta.AxisMetadata):
             names = getattr(leaf, "names", ())
             value = getattr(leaf, "value", None)
-            if getattr(value, "ndim", len(names)) != len(names):
+            # Unbox when the boxed value is not a matching-rank array — e.g.
+            # adafactor's factored rows/cols, or quantized-moment subtrees
+            # (q8_adam) where the box wraps a whole (q, scales) pytree.
+            if getattr(value, "ndim", None) != len(names):
                 return value
         return leaf
 

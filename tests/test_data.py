@@ -51,6 +51,62 @@ def test_loader_collate_and_prefetch():
     assert batches[0]["targets"].dtype == np.int32
 
 
+class _FakeTaskMaster:
+    """Minimal master double serving fixed-size shard tasks."""
+
+    def __init__(self, num_shards, shard_size):
+        self.tasks = [
+            type("T", (), dict(
+                task_id=i, start=i * shard_size, end=(i + 1) * shard_size,
+                empty=False, epoch=0, dataset_name="d",
+            ))()
+            for i in range(num_shards)
+        ]
+        self.done = []
+
+    def create_dataset(self, params):
+        pass
+
+    def get_task(self, name):
+        if self.tasks:
+            return self.tasks.pop(0)
+        return type("T", (), dict(task_id=-1, empty=True))()
+
+    def report_task(self, name, task_id, success):
+        self.done.append(task_id)
+
+
+def test_loader_acks_only_consumed_shards():
+    """A shard must not be acked while its batch sits in the prefetch queue
+    (crash would silently skip data); breaking early leaves shards unacked."""
+    from dlrover_tpu.data.loader import ElasticDataLoader
+    from dlrover_tpu.data.sharding_client import ShardingClient
+
+    fake = _FakeTaskMaster(num_shards=4, shard_size=8)
+    client = ShardingClient(fake, "d", create=False)
+    loader = ElasticDataLoader(
+        lambda i: {"x": np.asarray([i])}, batch_size=8,
+        source=client, prefetch=2,
+    )
+    it = iter(loader)
+    next(it)   # batch 0 handed out; shard 0 completes it but is NOT acked yet
+    assert fake.done == []
+    next(it)   # consumer came back: batch 0 was trained -> shard 0 acks
+    assert fake.done == [0]
+    it.close()  # abandon: shards 1..3 never acked (requeue via timeout)
+    assert fake.done == [0]
+
+    # full consumption acks everything
+    fake2 = _FakeTaskMaster(num_shards=3, shard_size=8)
+    client2 = ShardingClient(fake2, "d", create=False)
+    loader2 = ElasticDataLoader(
+        lambda i: {"x": np.asarray([i])}, batch_size=8,
+        source=client2, prefetch=2,
+    )
+    assert len(list(loader2)) == 3
+    assert sorted(fake2.done) == [0, 1, 2]
+
+
 def test_index_sharding_client_acks_batches():
     class FakeMaster:
         def __init__(self):

@@ -1,0 +1,107 @@
+"""Quantization + grouped matmul kernel tests (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.ops import grouped_matmul as gmm
+from dlrover_tpu.ops import quantization as qz
+
+
+def test_quantize_dequantize_roundtrip(rng):
+    x = jnp.asarray(rng.normal(size=(33, 77)) * 5.0, jnp.float32)
+    q, scales = qz.quantize(x)
+    assert q.dtype == jnp.int8
+    out = qz.dequantize(q, scales, x.shape)
+    # absmax/127 per 256-block: error bounded by scale/2 per block
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    bound = np.abs(np.asarray(x)).max() / 127.0
+    assert err.max() <= bound + 1e-6
+
+
+def test_q8_adam_tracks_fp32_adam(rng):
+    """Quantized Adam should follow full-precision Adam closely on a quadratic."""
+    dim = 8192  # above min_quant_size -> quantized path
+    target = jnp.asarray(rng.normal(size=(dim,)), jnp.float32)
+    params_q = {"w": jnp.zeros(dim, jnp.float32), "b": jnp.zeros(8, jnp.float32)}
+    params_f = {"w": jnp.zeros(dim, jnp.float32), "b": jnp.zeros(8, jnp.float32)}
+
+    opt_q = qz.q8_adam(learning_rate=0.05)
+    opt_f = optax.adam(0.05)
+    s_q, s_f = opt_q.init(params_q), opt_f.init(params_f)
+
+    def loss(p):
+        return jnp.sum((p["w"] - target) ** 2) + jnp.sum(p["b"] ** 2)
+
+    for _ in range(30):
+        g_q = jax.grad(loss)(params_q)
+        u_q, s_q = opt_q.update(g_q, s_q, params_q)
+        params_q = optax.apply_updates(params_q, u_q)
+        g_f = jax.grad(loss)(params_f)
+        u_f, s_f = opt_f.update(g_f, s_f, params_f)
+        params_f = optax.apply_updates(params_f, u_f)
+
+    # quantized Adam must descend comparably to fp32 Adam (a few % per-step
+    # state error is expected; divergence or stalls are not)
+    loss_q, loss_f = float(loss(params_q)), float(loss(params_f))
+    assert loss_q < 0.25 * dim, loss_q
+    assert loss_q < 2.0 * loss_f + 1.0, (loss_q, loss_f)
+    drift = jnp.abs(params_q["w"] - params_f["w"]).max()
+    assert float(drift) < 0.25, float(drift)
+
+
+def test_q8_adam_small_leaf_exact(rng):
+    """Small leaves bypass quantization and match optax.adam exactly."""
+    p = {"b": jnp.asarray(rng.normal(size=(16,)), jnp.float32)}
+    g = {"b": jnp.asarray(rng.normal(size=(16,)), jnp.float32)}
+    opt_q = qz.q8_adam(learning_rate=0.1)
+    opt_f = optax.adam(0.1, eps_root=0.0)
+    u_q, _ = opt_q.update(g, opt_q.init(p), p)
+    u_f, _ = opt_f.update(g, opt_f.init(p), p)
+    np.testing.assert_allclose(u_q["b"], u_f["b"], atol=1e-6, rtol=1e-5)
+
+
+def test_grouped_matmul_fwd(rng):
+    e, k, m = 4, 64, 128
+    sizes = jnp.asarray([256, 0, 128, 128], jnp.int32)
+    n = int(sizes.sum())
+    x = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, k, m)) * 0.1, jnp.float32)
+    out = gmm.grouped_matmul(x, w, sizes, block_rows=128)
+    ref = gmm.grouped_matmul_ref(x, w, sizes)
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_grouped_matmul_grads(rng):
+    e, k, m = 3, 64, 64
+    sizes = jnp.asarray([128, 256, 128], jnp.int32)
+    n = int(sizes.sum())
+    x = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, k, m)) * 0.1, jnp.float32)
+
+    def loss_kernel(x, w):
+        return jnp.sum(gmm.grouped_matmul(x, w, sizes, block_rows=128) ** 2)
+
+    def loss_ref(x, w):
+        return jnp.sum(gmm.grouped_matmul_ref(x, w, sizes) ** 2)
+
+    gx_k, gw_k = jax.grad(loss_kernel, argnums=(0, 1))(x, w)
+    gx_r, gw_r = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(gx_k, gx_r, atol=1e-3, rtol=1e-3)
+    np.testing.assert_allclose(gw_k, gw_r, atol=1e-3, rtol=1e-3)
+
+
+def test_grouped_matmul_empty_expert_grad(rng):
+    """dw of an expert with zero rows must be exactly zero (not NaN)."""
+    e, k, m = 3, 64, 64
+    sizes = jnp.asarray([256, 0, 128], jnp.int32)
+    n = int(sizes.sum())
+    x = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, k, m)), jnp.float32)
+    gw = jax.grad(
+        lambda w: jnp.sum(gmm.grouped_matmul(x, w, sizes, block_rows=128))
+    )(w)
+    assert np.all(np.isfinite(np.asarray(gw)))
+    np.testing.assert_array_equal(np.asarray(gw[1]), 0.0)

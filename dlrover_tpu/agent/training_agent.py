@@ -29,9 +29,11 @@ from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
 from dlrover_tpu.master.rdzv_manager import RendezvousName
 
-# Environment contract agent -> trainer.
-ENV_MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
-ENV_NODE_ID = "DLROVER_TPU_NODE_ID"
+from dlrover_tpu.common.constants import ConfigKey
+
+# Environment contract agent -> trainer (canonical names in ConfigKey).
+ENV_MASTER_ADDR = ConfigKey.MASTER_ADDR
+ENV_NODE_ID = ConfigKey.NODE_ID
 ENV_COORDINATOR = "DLROVER_TPU_COORDINATOR"
 ENV_NUM_PROC = "DLROVER_TPU_NUM_PROCESSES"
 ENV_PROC_ID = "DLROVER_TPU_PROCESS_ID"
@@ -189,8 +191,9 @@ class ElasticAgent:
         )
         if self._saver is not None:
             # The commit barrier counts done-files of the *sealed* world, not
-            # max_nodes — an elastic world of 3/4 hosts must still commit.
-            self._saver.num_hosts = len(rdzv["world"])
+            # max_nodes — an elastic world of 3/4 hosts must still commit,
+            # and the committer is its lowest live host id.
+            self._saver.set_world(sorted(rdzv["world"]))
         self._proc = subprocess.Popen(self.entrypoint, env=env)
         self.client.report_event("started")
         return rdzv

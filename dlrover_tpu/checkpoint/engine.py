@@ -16,10 +16,11 @@ per-local-rank arenas.
 from __future__ import annotations
 
 import dataclasses
+import os
 import pickle
 import time
 from enum import Enum
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -53,6 +54,23 @@ class CheckpointEvent:
     step: int = 0
 
 
+def default_host_index() -> int:
+    """Canonical host identity shared by agent, saver and trainer engine.
+
+    The agent names the shm arena / queue / lock after its ``node_id`` and
+    exports it as ``DLROVER_TPU_NODE_ID`` (agent->trainer env contract,
+    ``agent/training_agent.py``).  After an elastic shrink node ids are
+    non-contiguous, so ``jax.process_index()`` (always dense 0..n-1) would
+    dial channels no agent serves — prefer the env var whenever present.
+    """
+    from dlrover_tpu.common.constants import ConfigKey
+
+    env = os.environ.get(ConfigKey.NODE_ID)
+    if env is not None:
+        return int(env)
+    return jax.process_index()
+
+
 def shm_name(host_index: int) -> str:
     return f"h{host_index}"
 
@@ -79,13 +97,15 @@ class CheckpointEngine:
         host_index: Optional[int] = None,
         num_hosts: Optional[int] = None,
         local_saver: bool = False,
+        agree_step_fn: Optional[Callable[[int], int]] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.storage = storage or get_checkpoint_storage()
         self.layout = CheckpointDirLayout(checkpoint_dir)
         self.host_index = (
-            jax.process_index() if host_index is None else host_index
+            default_host_index() if host_index is None else host_index
         )
+        self._agree_step_fn = agree_step_fn
         self.num_hosts = (
             jax.process_count() if num_hosts is None else num_hosts
         )
@@ -152,44 +172,159 @@ class CheckpointEngine:
         shardings: Any = None,
         treedef: Any = None,
     ):
-        """Restore the newest state: shm first, then committed storage.
+        """Restore the newest *world-agreed* state: shm if it holds the agreed
+        step, committed storage otherwise.
+
+        Hosts must restore the same step — after an elastic restart a
+        surviving host may hold a newer shm step than a replaced host can see
+        on storage; resuming from different steps silently diverges
+        replicated state.  The candidate step is therefore agreed across
+        hosts (min over each host's best available step) before
+        materializing anything.
 
         Returns ``(step, state)`` where ``state`` is a pytree matching
         ``treedef`` (or a flat ``{path: array}`` dict when no treedef) with
         leaves ``device_put`` under ``shardings`` when given.
         """
         meta = self._shm.load_meta()
-        if meta is not None and self._all_local(meta):
-            logger.info("restoring step %d from shm", meta.step)
-            arrays = {
-                t.path: assemble_tensor(
-                    t, lambda r: self._shm.load_block(meta, r)
-                )
-                for t in meta.tensors
-            }
-            return meta.step, self._materialize(
-                arrays, meta, shardings, treedef
+        shm_ok = meta is not None and self._all_local(meta)
+        shm_step = meta.step if shm_ok else -1
+        known = [shm_step] + self.layout.committed_steps(self.storage)
+        # Walk candidates newest-first, re-agreeing after each failure so a
+        # corrupt newest step degrades to the next intact one on EVERY host
+        # (each agreement is a collective — all hosts iterate in lockstep).
+        upper: Optional[int] = None
+        while True:
+            local_best = max(
+                (s for s in known if upper is None or s < upper), default=-1
             )
-        return self.load_from_storage(shardings, treedef)
+            step = self._agree_restore_step(local_best)
+            if step < 0:
+                return -1, None
+            if upper is not None and step >= upper:
+                # Agreement is not making progress (custom agree_fn pinned to
+                # a dead step) — fail rather than spin.
+                return -1, None
+            if shm_ok and shm_step == step:
+                logger.info("restoring step %d from shm", step)
+                arrays = {
+                    t.path: assemble_tensor(
+                        t, lambda r: self._shm.load_block(meta, r)
+                    )
+                    for t in meta.tensors
+                }
+                return step, self._materialize(
+                    arrays, meta, shardings, treedef
+                )
+            result = self._load_step_from_storage(step, shardings, treedef)
+            if result is not None:
+                return step, result
+            logger.warning(
+                "agreed step %d not restorable; trying older steps", step
+            )
+            upper = step
 
-    def load_from_storage(self, shardings: Any = None, treedef: Any = None):
-        step = self.layout.latest_step(self.storage)
-        if step < 0:
-            return -1, None
+    def _agree_restore_step(self, candidate: int) -> int:
+        """Agree the restore step across the world (min of candidates).
+
+        Uses the injected ``agree_step_fn`` when given (tests, custom
+        fabrics); otherwise a jax host-collective when this is a real
+        multi-controller world.  Single-host worlds return the candidate.
+        """
+        if self._agree_step_fn is not None:
+            return self._agree_step_fn(candidate)
+        if self.num_hosts > 1 and jax.process_count() == self.num_hosts:
+            from jax.experimental import multihost_utils
+
+            steps = multihost_utils.process_allgather(
+                np.asarray(candidate, np.int64)
+            )
+            agreed = int(np.min(steps))
+            if agreed != candidate:
+                logger.info(
+                    "restore step agreed across hosts: %d (local best %d)",
+                    agreed, candidate,
+                )
+            return agreed
+        return candidate
+
+    def load_from_storage(
+        self,
+        shardings: Any = None,
+        treedef: Any = None,
+        step: Optional[int] = None,
+    ):
+        """Restore from durable storage.
+
+        With ``step=None`` tries the tracker's committed step first, then
+        older committed steps newest-first; an explicit ``step`` (the
+        world-agreed one) is tried alone — silently restoring a different
+        step than the rest of the world would diverge state.
+        """
+        if step is not None:
+            candidates = [step]
+        else:
+            tracked = self.layout.latest_step(self.storage)
+            candidates = sorted(
+                set(self.layout.committed_steps(self.storage)), reverse=True
+            )
+            if tracked >= 0:
+                candidates = [tracked] + [s for s in candidates if s != tracked]
+        for s in candidates:
+            if s < 0:
+                continue
+            result = self._load_step_from_storage(s, shardings, treedef)
+            if result is not None:
+                return s, result
+        return -1, None
+
+    def _load_step_from_storage(self, step: int, shardings, treedef):
+        """Load one step, or None if it is incomplete/corrupt.
+
+        The host set is discovered from the ``host_{i}_of_{n}.meta`` files
+        actually present (node ids are sparse after elastic shrinks — never
+        ``range(num_hosts)``); the step is rejected unless all ``n`` hosts'
+        meta+data are readable and every tensor's shard records fully cover
+        its global shape.
+        """
+        step_dir = self.layout.step_dir(step)
+        host_files: Dict[int, str] = {}
+        expected = None
+        for name in self.storage.listdir(step_dir):
+            if not name.endswith(".meta") or not name.startswith("host_"):
+                continue
+            try:
+                host = int(name[len("host_"):].split("_of_")[0])
+                n = int(name.split("_of_")[1].split(".")[0])
+            except (IndexError, ValueError):
+                continue
+            host_files[host] = name
+            expected = n if expected is None else expected
+        if expected is None:
+            logger.warning("step %d: no meta files in %s", step, step_dir)
+            return None
+        if len(host_files) != expected:
+            logger.error(
+                "step %d incomplete: %d/%d host metas present (hosts %s)",
+                step, len(host_files), expected, sorted(host_files),
+            )
+            return None
         metas: Dict[int, CheckpointMeta] = {}
         datas: Dict[int, bytes] = {}
-        num_hosts = self._discover_num_hosts(step)
-        for host in range(num_hosts):
-            raw = self.storage.read(self.layout.meta_path(step, host, num_hosts))
-            if raw is None:
-                logger.warning("step %d host %d meta missing", step, host)
-                continue
-            metas[host] = pickle.loads(raw)
-            datas[host] = self.storage.read(
-                self.layout.data_path(step, host, num_hosts)
-            )
-        if not metas:
-            return -1, None
+        for host in host_files:
+            raw = self.storage.read(self.layout.meta_path(step, host, expected))
+            data = self.storage.read(self.layout.data_path(step, host, expected))
+            if raw is None or data is None:
+                logger.error(
+                    "step %d host %d: meta or data unreadable", step, host
+                )
+                return None
+            try:
+                metas[host] = pickle.loads(raw)
+            except Exception as e:
+                logger.error("step %d host %d: meta corrupt: %s", step, host, e)
+                return None
+            datas[host] = data
         # Merge shard records across hosts per tensor path.
         merged: Dict[tuple, Any] = {}
         ref_meta = next(iter(metas.values()))
@@ -208,6 +343,17 @@ class CheckpointEngine:
                         continue  # replicated copy from another host
                     loaders[key] = (host, record)
                     combined.shards.append(record)
+            covered = sum(
+                int(np.prod(r.shape)) for r in combined.shards
+            )
+            total = int(np.prod(combined.global_shape))
+            if covered != total:
+                logger.error(
+                    "step %d tensor %s: shards cover %d/%d elements; "
+                    "refusing partial restore",
+                    step, path, covered, total,
+                )
+                return None
 
             def block_loader(record, _loaders=loaders, _datas=datas):
                 host, rec = _loaders[record.index]
@@ -218,17 +364,7 @@ class CheckpointEngine:
 
             merged[path] = assemble_tensor(combined, block_loader)
         logger.info("restored step %d from %s", step, self.checkpoint_dir)
-        return step, self._materialize(merged, ref_meta, shardings, treedef)
-
-    def _discover_num_hosts(self, step: int) -> int:
-        for name in self.storage.listdir(self.layout.step_dir(step)):
-            if name.endswith(".meta"):
-                # host_{i}_of_{n}.meta
-                try:
-                    return int(name.split("_of_")[1].split(".")[0])
-                except (IndexError, ValueError):
-                    continue
-        return self.num_hosts
+        return self._materialize(merged, ref_meta, shardings, treedef)
 
     def _all_local(self, meta: CheckpointMeta) -> bool:
         return all(t.local_covers_global for t in meta.tensors)
@@ -257,8 +393,10 @@ class CheckpointEngine:
         target = self._latest_storage_step
         if target < 0:
             return True
-        # Host 0 must additionally wait for the cross-host commit.
-        key = "committed_step" if self.host_index == 0 else "persisted_step"
+        # The committing host (lowest live host id, published by the saver)
+        # must additionally wait for the cross-host commit.
+        committer = self._status.get("is_committer", self.host_index == 0)
+        key = "committed_step" if committer else "persisted_step"
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             done = self._status.get(key, -1)

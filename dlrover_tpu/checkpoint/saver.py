@@ -58,6 +58,10 @@ class AsyncCheckpointSaver:
         self.layout = CheckpointDirLayout(checkpoint_dir)
         self.host_index = host_index
         self.num_hosts = num_hosts
+        # Host ids of the sealed world (sparse after shrinks).  The commit
+        # barrier is driven by the lowest live host — a hardcoded "host 0"
+        # would never commit once node 0 has been evicted.
+        self.world_hosts: Optional[list] = None
         self.deletion_strategy = deletion_strategy or KeepLatestStepStrategy(3)
         self.commit_timeout = commit_timeout
         self._shm = SharedMemoryHandler(shm_name(host_index))
@@ -69,7 +73,13 @@ class AsyncCheckpointSaver:
         from dlrover_tpu.checkpoint.engine import status_name
 
         self._status = SharedDict(status_name(host_index), create=True)
-        self._status.update({"persisted_step": -1, "committed_step": -1})
+        self._status.update(
+            {
+                "persisted_step": -1,
+                "committed_step": -1,
+                "is_committer": host_index == 0,
+            }
+        )
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self._persisted_step = -1
@@ -191,19 +201,69 @@ class AsyncCheckpointSaver:
             self._lock.release()
         self._persisted_step = step
         self._status.set("persisted_step", step)
-        if self.host_index == 0:
-            self.commit_checkpoint(step)
+        if self._is_committer():
+            # Snapshot the sealed world NOW: a rendezvous shrink arriving
+            # mid-commit (set_world from the agent thread) must not lower
+            # the bar and let an incomplete step commit.
+            self.commit_checkpoint(
+                step,
+                expected_hosts=(
+                    list(self.world_hosts) if self.world_hosts else None
+                ),
+                num_hosts=self.num_hosts,
+            )
         return True
 
-    def commit_checkpoint(self, step: int):
-        """Host 0 waits for every host's done-file, then flips the tracker."""
+    def set_world(self, world_hosts: list):
+        """Called by the agent after each sealed rendezvous: the commit
+        barrier counts done-files of the *sealed* world and is driven by its
+        lowest live host id."""
+        self.world_hosts = sorted(world_hosts)
+        self.num_hosts = len(self.world_hosts)
+        self._status.set("is_committer", self._is_committer())
+
+    def _is_committer(self) -> bool:
+        if self.world_hosts:
+            return self.host_index == min(self.world_hosts)
+        return self.host_index == 0
+
+    def _count_done_files(self, step: int) -> int:
+        """Count per-host done markers by listing the step dir.
+
+        Node ids are sparse after elastic shrinks (e.g. hosts {0, 2} in a
+        2-host world), so enumerating ``range(num_hosts)`` would wait for
+        ``host_1.done`` forever; only the *count* of distinct done files is
+        meaningful.
+        """
+        return sum(
+            1
+            for name in self.storage.listdir(self.layout.step_dir(step))
+            if name.startswith("host_") and name.endswith(".done")
+        )
+
+    def commit_checkpoint(
+        self,
+        step: int,
+        expected_hosts: Optional[list] = None,
+        num_hosts: Optional[int] = None,
+    ):
+        """The committer waits for every sealed-world host's done-file, then
+        flips the tracker.  ``expected_hosts``/``num_hosts`` are snapshots of
+        the world the step was saved under — never re-read mutable saver
+        state inside the poll loop."""
+        need = len(expected_hosts) if expected_hosts else (
+            num_hosts if num_hosts is not None else self.num_hosts
+        )
         deadline = time.monotonic() + self.commit_timeout
         while time.monotonic() < deadline:
-            done = sum(
-                self.storage.exists(self.layout.done_path(step, h))
-                for h in range(self.num_hosts)
-            )
-            if done == self.num_hosts:
+            if expected_hosts:
+                done = sum(
+                    self.storage.exists(self.layout.done_path(step, h))
+                    for h in expected_hosts
+                )
+            else:
+                done = self._count_done_files(step)
+            if done >= need:
                 self.storage.write(str(step), self.layout.tracker_path())
                 self.storage.commit(step, True)
                 self._status.set("committed_step", step)
@@ -211,7 +271,7 @@ class AsyncCheckpointSaver:
                 self._clean_up(step)
                 return
             time.sleep(0.5)
-        logger.error("commit of step %d timed out (%d hosts)", step, self.num_hosts)
+        logger.error("commit of step %d timed out (%d hosts)", step, need)
         self.storage.commit(step, False)
 
     def _clean_up(self, committed_step: int):

@@ -143,6 +143,18 @@ class LocalSocketComm:
         return os.path.exists(self._path)
 
 
+def _pid_alive(owner: str) -> bool:
+    """Owner tokens are ``pid:thread_ident`` — check the pid still exists."""
+    try:
+        pid = int(owner.split(":", 1)[0])
+        os.kill(pid, 0)
+        return True
+    except (ValueError, ProcessLookupError):
+        return False
+    except PermissionError:
+        return True
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
@@ -165,22 +177,44 @@ class SharedLock(LocalSocketComm):
 
     def __init__(self, name: str, create: bool = False):
         self._lock = threading.Lock() if create else None
+        # Serializes the acquire/steal/release state machine: RPC handler
+        # threads and the server process's own local calls run concurrently,
+        # and an unguarded check-then-steal could grant two owners at once.
+        self._state_guard = threading.Lock() if create else None
         self._owner: Optional[str] = None
         super().__init__("lock", name, create)
 
     def _srv_acquire(self, owner: str) -> bool:
-        got = self._lock.acquire(blocking=False)
-        if got:
-            self._owner = owner
-            return True
-        return self._owner == owner  # reentrant / lost-response retry
+        with self._state_guard:
+            got = self._lock.acquire(blocking=False)
+            if got:
+                self._owner = owner
+                return True
+            if self._owner == owner:  # reentrant / lost-response retry
+                return True
+            if self._owner is not None and not _pid_alive(self._owner):
+                # Owner died mid-critical-section (e.g. trainer SIGKILLed
+                # during a shm save); the section's invariants are void
+                # anyway, so hand the lock over rather than deadlocking
+                # every future waiter.
+                logger.warning(
+                    "lock %s: stealing from dead owner %s",
+                    self._name, self._owner,
+                )
+                self._owner = owner
+                return True
+            return False
 
     def _srv_release(self, owner: str) -> bool:
-        if self._lock.locked():
-            self._owner = None
-            self._lock.release()
-            return True
-        return False
+        # Only the tracked owner may release: a stale release from another
+        # process must not break mutual exclusion mid-critical-section
+        # (e.g. while the saver is persisting the shm arena).
+        with self._state_guard:
+            if self._lock.locked() and self._owner == owner:
+                self._owner = None
+                self._lock.release()
+                return True
+            return False
 
     def _srv_locked(self) -> bool:
         return self._lock.locked()

@@ -215,8 +215,12 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 groups.append([suspect])
         if len(pool) > 1:
             groups.extend(
-                [pool[i : i + 2] for i in range(0, len(pool) - 1, 2)]
+                [pool[i : i + 2] for i in range(0, len(pool), 2)]
             )
+            # A trailing singleton can't allgather-probe; merge it (mirrors
+            # the round-0 merge so no node spins in an empty comm world).
+            if len(groups[-1]) == 1:
+                groups[-2].extend(groups.pop())
         elif pool:
             if groups:
                 groups[-1].append(pool[0])

@@ -228,3 +228,119 @@ def test_saver_sigterm_persist_path(tmp_path):
     assert layout.latest_step(PosixDiskStorage()) == 33
     engine.close()
     saver.stop()
+
+
+def test_sparse_host_ids_commit_and_restore(tmp_path, monkeypatch):
+    """ADVICE high: after an elastic shrink the live hosts may be {1, 2} —
+    the commit barrier must count actual done-files (not range(num_hosts)),
+    the committer must be the lowest *live* host, and restore must enumerate
+    the host files actually present."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    savers, engines = {}, {}
+    for host in (1, 2):
+        savers[host] = AsyncCheckpointSaver(ckpt_dir, host_index=host)
+        savers[host].set_world([1, 2])
+        savers[host].start()
+        engines[host] = CheckpointEngine(
+            ckpt_dir, host_index=host, num_hosts=2,
+            agree_step_fn=lambda c: c,
+        )
+    state = {"w": jnp.full((2, 2), 5.0)}
+    for host in (1, 2):
+        assert engines[host].save_to_storage(21, state)
+    # Host 1 is the committer (lowest live id); host 2 only persists.
+    assert engines[1].wait_saver(timeout=30)
+    layout = CheckpointDirLayout(ckpt_dir)
+    assert layout.latest_step(PosixDiskStorage()) == 21
+
+    # Fresh-process restore: shm gone, storage globbed by actual host ids.
+    for host in (1, 2):
+        engines[host]._shm.close(unlink=True)
+    fresh = CheckpointEngine(
+        ckpt_dir, host_index=1, num_hosts=2, agree_step_fn=lambda c: c
+    )
+    step, loaded = fresh.load(treedef=jax.tree_util.tree_structure(state))
+    assert step == 21
+    np.testing.assert_allclose(loaded["w"], np.full((2, 2), 5.0))
+    for host in (1, 2):
+        savers[host].stop()
+
+
+def test_restore_rejects_incomplete_step_and_falls_back(tmp_path):
+    """ADVICE medium: a step with a missing host data file must not be
+    restored from np.empty garbage — fall back to the older committed step."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    saver.start()
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    good = {"w": jnp.full((3,), 1.0)}
+    newer = {"w": jnp.full((3,), 2.0)}
+    assert engine.save_to_storage(10, good)
+    assert engine.wait_saver(timeout=30)
+    assert engine.save_to_storage(20, newer)
+    assert engine.wait_saver(timeout=30)
+
+    layout = CheckpointDirLayout(ckpt_dir)
+    os.remove(layout.data_path(20, 0, 1))
+    engine._shm.close(unlink=True)
+    step, loaded = engine.load_from_storage(
+        treedef=jax.tree_util.tree_structure(good)
+    )
+    assert step == 10
+    np.testing.assert_allclose(loaded["w"], np.full((3,), 1.0))
+    saver.stop()
+
+
+def test_world_agreed_step_overrides_newer_shm(tmp_path):
+    """ADVICE medium: a surviving host whose shm holds step 30 must restore
+    the world-agreed step 10 from storage, not its own newer shm."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    saver.start()
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: 10
+    )
+    assert engine.save_to_storage(10, {"w": jnp.full((3,), 1.0)})
+    assert engine.wait_saver(timeout=30)
+    assert engine.save_to_memory(30, {"w": jnp.full((3,), 3.0)})
+    step, loaded = engine.load(
+        treedef=jax.tree_util.tree_structure({"w": jnp.zeros((3,))})
+    )
+    assert step == 10
+    np.testing.assert_allclose(loaded["w"], np.full((3,), 1.0))
+    engine._shm.close(unlink=True)
+    saver.stop()
+
+
+def test_lock_release_requires_owner_and_steals_from_dead(tmp_path):
+    server = mp_ipc.SharedLock("ladv", create=True)
+    client = mp_ipc.SharedLock("ladv", create=False)
+    assert client.acquire()
+    # ADVICE low: a release from a different owner (thread) is refused.
+    stray: list = []
+    t = threading.Thread(target=lambda: stray.append(server.release()))
+    t.start(); t.join()
+    assert stray == [False]
+    assert server._lock.locked()
+    assert client.release()
+    # Dead-owner steal: lock held by a pid that no longer exists.
+    assert client.acquire()
+    server._owner = "999999999:1"
+    other: list = []
+    t = threading.Thread(
+        target=lambda: other.append(server.acquire(blocking=False))
+    )
+    t.start(); t.join()
+    assert other == [True]
+    server.close()

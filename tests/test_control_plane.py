@@ -185,3 +185,18 @@ def test_speed_monitor_goodput():
     monitor.collect_global_step(2, t0 + 1)
     assert monitor.no_progress_for() < 5
     assert 0.0 <= monitor.goodput() <= 1.0
+
+
+def test_network_check_odd_healthy_pool_no_singleton():
+    """ADVICE low: round>=1 grouping with an odd healthy pool must not
+    strand the last node in a singleton/empty comm world."""
+    from dlrover_tpu.master.rdzv_manager import NetworkCheckRendezvousManager
+
+    mgr = NetworkCheckRendezvousManager()
+    mgr._rdzv_nodes = {r: 1 for r in range(5)}
+    for r in range(5):
+        mgr._node_status[r] = True  # all healthy -> pool of 5, no suspects
+    groups = mgr._group_nodes(check_round=1)
+    covered = sorted(r for g in groups for r in g)
+    assert covered == list(range(5))
+    assert all(len(g) >= 2 for g in groups)

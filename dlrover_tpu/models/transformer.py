@@ -23,6 +23,7 @@ from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 
 from dlrover_tpu.models import layers
@@ -59,6 +60,10 @@ class TransformerConfig:
     remat: str = "none"            # "none" | "dots" | "full"
     scan_layers: bool = True
     logits_dtype: Any = jnp.float32
+    # Pipeline parallelism (see parallel/pipeline.py): stages must divide
+    # num_layers; microbatches default to the stage count.
+    pipeline_stages: int = 1
+    num_microbatches: int = 0
 
     @property
     def resolved_kv_heads(self) -> int:
@@ -174,6 +179,10 @@ class Block(nn.Module):
             attention_impl=cfg.attention_impl,
             name="attn",
         )(y, positions, segment_ids)
+        # Named checkpoint: under the "attn_out" remat policy the backward
+        # skips re-running the whole attention forward (the priciest part of
+        # recompute) at b*s*d bf16 per layer of extra HBM.
+        y = jax.ad_checkpoint.checkpoint_name(y, "attn_out")
         x = x + y
         y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_mlp")(x)
         if cfg.num_experts:
@@ -208,6 +217,9 @@ _REMAT_POLICIES = {
     # save matmul outputs, recompute elementwise (good HBM/FLOP tradeoff)
     "dots": jax.checkpoint_policies.checkpoint_dots,
     "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    # save only the attention block output (cheap in HBM, skips the most
+    # expensive recompute); everything else rematerializes
+    "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
 }
 
 
@@ -263,7 +275,13 @@ class TransformerLM(nn.Module):
                 static_argnums=(),
             )
         aux0 = jnp.zeros((), jnp.float32)
-        if cfg.scan_layers:
+        if cfg.pipeline_stages > 1:
+            from dlrover_tpu.parallel.pipeline import PipelinedBlocks
+
+            x, aux = PipelinedBlocks(cfg, block_cls, name="blocks")(
+                x, aux0, positions, segment_ids
+            )
+        elif cfg.scan_layers:
             stack = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},

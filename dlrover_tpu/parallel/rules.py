@@ -51,7 +51,8 @@ HEADS = "heads"            # param attention heads dim (TP split)
 KV = "kv"                  # param per-head dim
 VOCAB = "vocab"            # param vocab dim (TP vocab split)
 EXPERT = "expert"          # param expert dim (EP shard dim)
-LAYERS = "layers"          # scanned layer dim (pipeline stage dim)
+LAYERS = "layers"          # scanned layer dim (within one pipeline stage)
+STAGES = "stages"          # pipeline stage dim (params + rolling state buffer)
 NORM = "norm"              # 1-D norm scales/biases
 
 
@@ -61,7 +62,7 @@ def make_rules(
     tensor: bool = True,
     sequence: bool = True,
     expert: bool = True,
-    pipeline: bool = False,
+    pipeline: bool = True,
     context: str = "ulysses",
 ) -> List[Tuple[str, MeshAxes]]:
     """Build the rule table for a strategy combination.
@@ -100,7 +101,10 @@ def make_rules(
     else:
         rules += [(MLP, None), (HEADS, None), (VOCAB, None)]
     rules.append((EXPERT, EXPERT_AXIS if expert else None))
-    rules.append((LAYERS, PIPE_AXIS if pipeline else None))
+    # Pipelining shards the *stage* dim (see parallel/pipeline.py); the
+    # per-stage layer dim stays unsharded.
+    rules.append((STAGES, PIPE_AXIS if pipeline else None))
+    rules.append((LAYERS, None))
     return rules
 
 

@@ -1,0 +1,149 @@
+"""Pipeline parallelism: loss parity with the non-pipelined stack and a
+sharded end-to-end train step over a real pipe axis.
+
+Mirrors the reference's pipeline tests (SURVEY.md §4 ``pipeline_test.py``,
+498 LoC: multi-proc groups on one host, toy models, loss checks) on the
+virtual CPU mesh.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.transformer import TransformerLM
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.trainer import train_lib
+
+
+def _tiny(pp=1, micro=0, **kw):
+    return gpt2_config(
+        "124m", num_layers=4, d_model=32, num_heads=4, vocab_size=128,
+        max_seq_len=16, pipeline_stages=pp, num_microbatches=micro, **kw
+    )
+
+
+def _reshape_params_for_stages(params, stages):
+    """pp=1 scanned params [L, ...] -> pipelined [S, L/S, ...] pytree."""
+    blocks = params["blocks"]
+    def reshape(leaf):
+        return leaf.reshape(stages, leaf.shape[0] // stages, *leaf.shape[1:])
+    piped = {
+        "ticks": {"stages": {"layers": jax.tree.map(reshape, blocks)}}
+    }
+    out = dict(params)
+    out["blocks"] = piped
+    return out
+
+
+def test_pipeline_matches_sequential_forward():
+    cfg1 = _tiny(pp=1)
+    cfg2 = _tiny(pp=2, micro=2)
+    m1, m2 = TransformerLM(cfg1), TransformerLM(cfg2)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+    p1 = nn.meta.unbox(m1.init(jax.random.PRNGKey(0), tokens)["params"])
+    p2 = _reshape_params_for_stages(p1, stages=2)
+    # Structure must match what the pipelined model would itself create.
+    ref = jax.tree.structure(
+        nn.meta.unbox(m2.init(jax.random.PRNGKey(0), tokens)["params"])
+    )
+    assert jax.tree.structure(p2) == ref
+    logits1, _ = m1.apply({"params": p1}, tokens)
+    logits2, _ = m2.apply({"params": p2}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits1), np.asarray(logits2), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_pipeline_sharded_train_step_runs_and_matches_loss():
+    """pp=2 x dp=2 x fsdp=2 on the 8-device CPU mesh: the full sharded train
+    step must run and its first-step loss must match the pp=1 loss on the
+    same params/batch."""
+    devices = jax.devices()[:8]
+    batch, seq = 8, 16
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 128, size=(batch, seq + 1), dtype=np.int32)
+
+    losses = {}
+    for pp in (1, 2):
+        cfg = _tiny(pp=pp, micro=4 if pp > 1 else 0, remat="full")
+        model = TransformerLM(cfg)
+        mesh = build_mesh(
+            ParallelConfig(data=2, fsdp=2, pipe=pp, tensor=1),
+            devices=devices[: 4 * pp],
+        )
+        train = train_lib.build_sharded_train(
+            model, train_lib.make_optimizer("sgd", learning_rate=0.0),
+            mesh, lr.DEFAULT_RULES,
+            global_batch_size=batch, seq_len=seq,
+        )
+        if pp == 1:
+            state = train.init(jax.random.PRNGKey(0))
+            params1 = jax.tree.map(np.asarray, state.params)
+        else:
+            state = train.init(jax.random.PRNGKey(0))
+            piped = _reshape_params_for_stages(params1, stages=2)
+            state = state.replace(
+                params=jax.tree.map(
+                    lambda t, s: jax.device_put(t, s.sharding),
+                    piped,
+                    state.params,
+                )
+            )
+        b = train_lib.shard_batch(
+            {"inputs": tokens[:, :-1].copy(), "targets": tokens[:, 1:].copy()},
+            train,
+        )
+        _, metrics = train.step(state, b)
+        losses[pp] = float(metrics["loss"])
+    assert np.isfinite(losses[2])
+    np.testing.assert_allclose(losses[2], losses[1], rtol=2e-3)
+
+
+def test_pipeline_grads_match_sequential():
+    """AD through the tick loop (the reverse-schedule backward) must produce
+    the same gradients as the plain layer scan."""
+    cfg1 = _tiny(pp=1)
+    cfg2 = _tiny(pp=2, micro=2)
+    m1, m2 = TransformerLM(cfg1), TransformerLM(cfg2)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 128)
+    p1 = nn.meta.unbox(m1.init(jax.random.PRNGKey(0), tokens)["params"])
+    p2 = _reshape_params_for_stages(p1, stages=2)
+
+    def loss1(p):
+        logits, _ = m1.apply({"params": p}, tokens)
+        return train_lib.cross_entropy_loss(logits, targets)[0]
+
+    def loss2(p):
+        logits, _ = m2.apply({"params": p}, tokens)
+        return train_lib.cross_entropy_loss(logits, targets)[0]
+
+    g1 = jax.grad(loss1)(p1)
+    g2 = jax.grad(loss2)(p2)
+    g1_piped = _reshape_params_for_stages(g1, stages=2)
+    flat1 = jax.tree.leaves(g1_piped)
+    flat2 = jax.tree.leaves(g2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_pipeline_validates_config():
+    with pytest.raises(ValueError, match="divisible"):
+        cfg = _tiny(pp=3)
+        TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32)
+        )
+    with pytest.raises(NotImplementedError, match="MoE"):
+        cfg = gpt2_config(
+            "124m", num_layers=4, d_model=32, num_heads=4, vocab_size=128,
+            max_seq_len=16, pipeline_stages=2, num_experts=2,
+        )
+        TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((4, 16), jnp.int32)
+        )

@@ -72,9 +72,11 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [block_kv, d]
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Matmul inputs stay bf16 (MXU native rate); accumulation is fp32 via
+        # preferred_element_type — the standard flash-attention numerics.
+        q = q_ref[0, 0]  # [block_q, d]
+        k = k_ref[0, 0]  # [block_kv, d]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -198,10 +200,10 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, 0][:, None]
         delta = delta_ref[0, 0][:, 0][:, None]
         s = jax.lax.dot_general(
@@ -224,7 +226,7 @@ def _bwd_dq_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_acc_ref[:] += jax.lax.dot(
             ds, k, preferred_element_type=jnp.float32
         )
@@ -252,10 +254,10 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, 0][:, None]
         delta = delta_ref[0, 0][:, 0][:, None]
         s = jax.lax.dot_general(
@@ -274,15 +276,16 @@ def _bwd_dkv_kernel(
         seg = seg_q_ref[0, 0][:, None] == seg_kv_ref[0, 0][None, :]
         mask = seg if mask is None else jnp.logical_and(mask, seg)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        pb = p.astype(do.dtype)
         dv_acc_ref[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

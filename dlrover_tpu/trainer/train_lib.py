@@ -102,6 +102,82 @@ def cross_entropy_loss(
     return (loss * weights).sum() / total_weight, total_weight
 
 
+def chunked_cross_entropy_loss(
+    hidden: jax.Array,
+    head: jax.Array,
+    targets: jax.Array,
+    weights: Optional[jax.Array] = None,
+    *,
+    num_chunks: int = 8,
+    z_loss: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Softmax CE from final hidden states without full-logit materialization.
+
+    Computes logits chunk-by-chunk over the sequence axis inside a
+    rematerialized ``lax.scan``, so the [B, S, V] fp32 logits tensor (3.3 GB
+    at the 1.5B bench shape) never lives in HBM — the backward recomputes
+    each chunk's logits.  This is the fused/vocab-CE counterpart of the
+    reference's fused cross-entropy kernels
+    (ref ``atorch/atorch/modules/transformer/cross_entropy.py``), done the
+    XLA way: a small scan + checkpoint instead of a custom kernel.
+
+    Args:
+      hidden: [B, S, D] final (normed) hidden states.
+      head:   [V, D] output head — the tied embedding table, or lm_head
+              kernel transposed.
+      targets: [B, S] int labels.  weights: [B, S] or None.
+    """
+    b, s, d = hidden.shape
+    if weights is None:
+        weights = jnp.ones((b, s), jnp.float32)
+    num_chunks = max(1, min(num_chunks, s))
+    while s % num_chunks:
+        num_chunks -= 1
+    c = s // num_chunks
+    xs = (
+        hidden.reshape(b, num_chunks, c, d).swapaxes(0, 1),
+        targets.reshape(b, num_chunks, c).swapaxes(0, 1),
+        weights.reshape(b, num_chunks, c).swapaxes(0, 1),
+    )
+
+    def chunk_fn(carry, inp):
+        x_c, t_c, w_c = inp
+        logits = jnp.einsum(
+            "bcd,vd->bcv",
+            x_c.astype(head.dtype),
+            head,
+            preferred_element_type=jnp.float32,
+        )
+        log_z = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(
+            logits, t_c[..., None], axis=-1
+        )[..., 0]
+        loss = log_z - label_logits
+        if z_loss:
+            loss = loss + z_loss * jnp.square(log_z)
+        w = w_c.astype(jnp.float32)
+        return (carry[0] + (loss * w).sum(), carry[1] + w.sum()), None
+
+    (total, total_weight), _ = jax.lax.scan(
+        jax.checkpoint(chunk_fn), (jnp.zeros(()), jnp.zeros(())), xs
+    )
+    total_weight = jnp.maximum(total_weight, 1.0)
+    return total / total_weight, total_weight
+
+
+def output_head(params: Dict[str, Any]) -> jax.Array:
+    """[V, D] output projection from a TransformerLM param tree."""
+    if "lm_head" in params:
+        kernel = params["lm_head"]["kernel"]  # [D, V]
+        if isinstance(kernel, nn.meta.AxisMetadata):
+            kernel = kernel.value
+        return kernel.T
+    table = params["embed"]["embedding"]  # [V, D]
+    if isinstance(table, nn.meta.AxisMetadata):
+        table = table.value
+    return table
+
+
 @dataclasses.dataclass
 class ShardedTrain:
     """A compiled SPMD training program bound to one mesh + rule table."""
@@ -165,6 +241,7 @@ def build_sharded_train(
     global_batch_size: int,
     seq_len: int,
     donate_state: bool = True,
+    ce_chunks: int = 0,
 ) -> ShardedTrain:
     """Construct init/step functions jitted with mesh shardings.
 
@@ -214,12 +291,21 @@ def build_sharded_train(
 
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):
         def loss_fn(params):
-            logits, aux = state.apply_fn(
-                {"params": params}, batch["inputs"]
-            )
-            ce, total_weight = cross_entropy_loss(
-                logits, batch["targets"], batch["weights"]
-            )
+            if ce_chunks:
+                hidden, aux = state.apply_fn(
+                    {"params": params}, batch["inputs"], return_hidden=True
+                )
+                ce, total_weight = chunked_cross_entropy_loss(
+                    hidden, output_head(params), batch["targets"],
+                    batch["weights"], num_chunks=ce_chunks,
+                )
+            else:
+                logits, aux = state.apply_fn(
+                    {"params": params}, batch["inputs"]
+                )
+                ce, total_weight = cross_entropy_loss(
+                    logits, batch["targets"], batch["weights"]
+                )
             return ce + aux, (ce, aux, total_weight)
 
         grads, (ce, aux, total_weight) = jax.grad(loss_fn, has_aux=True)(

@@ -98,6 +98,7 @@ class CheckpointEngine:
         num_hosts: Optional[int] = None,
         local_saver: bool = False,
         agree_step_fn: Optional[Callable[[int], int]] = None,
+        agree_min_fn: Optional[Callable[[int], int]] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.storage = storage or get_checkpoint_storage()
@@ -106,6 +107,7 @@ class CheckpointEngine:
             default_host_index() if host_index is None else host_index
         )
         self._agree_step_fn = agree_step_fn
+        self._agree_min_fn = agree_min_fn
         self.num_hosts = (
             jax.process_count() if num_hosts is None else num_hosts
         )
@@ -191,8 +193,11 @@ class CheckpointEngine:
         shm_step = meta.step if shm_ok else -1
         known = [shm_step] + self.layout.committed_steps(self.storage)
         # Walk candidates newest-first, re-agreeing after each failure so a
-        # corrupt newest step degrades to the next intact one on EVERY host
-        # (each agreement is a collective — all hosts iterate in lockstep).
+        # corrupt newest step degrades to the next intact one on EVERY host.
+        # Every iteration runs exactly two collectives on every host — the
+        # step agreement and the outcome agreement — so hosts whose local
+        # attempt succeeded keep participating until the whole world
+        # succeeds (a lone host retrying would hang in a dead collective).
         upper: Optional[int] = None
         while True:
             local_best = max(
@@ -213,14 +218,16 @@ class CheckpointEngine:
                     )
                     for t in meta.tensors
                 }
-                return step, self._materialize(
-                    arrays, meta, shardings, treedef
-                )
-            result = self._load_step_from_storage(step, shardings, treedef)
-            if result is not None:
+                result = self._materialize(arrays, meta, shardings, treedef)
+            else:
+                result = self._load_step_from_storage(step, shardings, treedef)
+            world_ok = self._agree_min(1 if result is not None else 0) > 0
+            if world_ok:
                 return step, result
             logger.warning(
-                "agreed step %d not restorable; trying older steps", step
+                "agreed step %d not restorable on every host; trying older "
+                "steps (local attempt %s)",
+                step, "succeeded" if result is not None else "failed",
             )
             upper = step
 
@@ -228,25 +235,44 @@ class CheckpointEngine:
         """Agree the restore step across the world (min of candidates).
 
         Uses the injected ``agree_step_fn`` when given (tests, custom
-        fabrics); otherwise a jax host-collective when this is a real
-        multi-controller world.  Single-host worlds return the candidate.
+        fabrics); otherwise the shared min-agreement fabric.
         """
         if self._agree_step_fn is not None:
             return self._agree_step_fn(candidate)
+        agreed = self._agree_min(candidate)
+        if agreed != candidate:
+            logger.info(
+                "restore step agreed across hosts: %d (local best %d)",
+                agreed, candidate,
+            )
+        return agreed
+
+    def _agree_min(self, value: int) -> int:
+        """Min-reduce ``value`` across the restore world.
+
+        Falls back to the local value — loudly — when the collective cannot
+        run (jax.distributed not initialized, or the agent's ``num_hosts``
+        disagreeing with ``jax.process_count()``): silently no-opping here
+        would disable the divergent-restore guard exactly in the degraded
+        states it exists for.
+        """
+        if self._agree_min_fn is not None:
+            return self._agree_min_fn(value)
         if self.num_hosts > 1 and jax.process_count() == self.num_hosts:
             from jax.experimental import multihost_utils
 
-            steps = multihost_utils.process_allgather(
-                np.asarray(candidate, np.int64)
+            values = multihost_utils.process_allgather(
+                np.asarray(value, np.int64)
             )
-            agreed = int(np.min(steps))
-            if agreed != candidate:
-                logger.info(
-                    "restore step agreed across hosts: %d (local best %d)",
-                    agreed, candidate,
-                )
-            return agreed
-        return candidate
+            return int(np.min(values))
+        if self.num_hosts > 1:
+            logger.error(
+                "restore agreement DEGRADED to local-only: num_hosts=%d but "
+                "jax.process_count()=%d — cross-host divergent-restore "
+                "protection is OFF for this restore",
+                self.num_hosts, jax.process_count(),
+            )
+        return value
 
     def load_from_storage(
         self,
@@ -288,8 +314,7 @@ class CheckpointEngine:
         its global shape.
         """
         step_dir = self.layout.step_dir(step)
-        host_files: Dict[int, str] = {}
-        expected = None
+        groups: Dict[int, Dict[int, str]] = {}
         for name in self.storage.listdir(step_dir):
             if not name.endswith(".meta") or not name.startswith("host_"):
                 continue
@@ -298,17 +323,30 @@ class CheckpointEngine:
                 n = int(name.split("_of_")[1].split(".")[0])
             except (IndexError, ValueError):
                 continue
-            host_files[host] = name
-            expected = n if expected is None else expected
-        if expected is None:
+            groups.setdefault(n, {})[host] = name
+        if not groups:
             logger.warning("step %d: no meta files in %s", step, step_dir)
             return None
-        if len(host_files) != expected:
+        if len(groups) > 1:
             logger.error(
-                "step %d incomplete: %d/%d host metas present (hosts %s)",
-                step, len(host_files), expected, sorted(host_files),
+                "step %d: meta files from mixed world sizes %s in %s (stale "
+                "files from a previous world survived a re-save)",
+                step, sorted(groups), step_dir,
+            )
+        complete = {n: hosts for n, hosts in groups.items() if len(hosts) == n}
+        if len(complete) != 1:
+            # Zero complete groups: the step is genuinely partial.  More than
+            # one: two worlds each left a self-consistent set and nothing
+            # here can tell which one the tracker meant — reject the step so
+            # restore degrades to an older unambiguous one.
+            logger.error(
+                "step %d not restorable: world-size groups %s, complete %s",
+                step,
+                {n: sorted(h) for n, h in groups.items()},
+                sorted(complete),
             )
             return None
+        expected, host_files = next(iter(complete.items()))
         metas: Dict[int, CheckpointMeta] = {}
         datas: Dict[int, bytes] = {}
         for host in host_files:

@@ -10,6 +10,7 @@ the tracker update so a reader never sees a deleted-but-tracked step.
 
 from __future__ import annotations
 
+import os
 import pickle
 import signal
 import threading
@@ -83,6 +84,7 @@ class AsyncCheckpointSaver:
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self._persisted_step = -1
+        self._cleaned_steps: set = set()
         AsyncCheckpointSaver._instance = self
 
     # -- lifecycle ------------------------------------------------------------
@@ -156,16 +158,40 @@ class AsyncCheckpointSaver:
 
     # -- persist + commit -----------------------------------------------------
 
+    BREAKPOINT_COMMIT_TIMEOUT = 15.0
+
     def save_shm_to_storage(self) -> bool:
-        """Persist whatever is in shm right now (failure/SIGTERM path)."""
+        """Persist whatever is in shm right now (failure/SIGTERM/membership
+        path).  The commit barrier gets a short timeout here: when the world
+        just lost a member its done-file never appears, and blocking the
+        restart (or the SIGTERM grace window) for the full commit timeout
+        would cost the whole preemption budget.  Peers that are alive all
+        persist within seconds, so a healthy world still commits."""
         meta = self._shm.load_meta()
         if meta is None:
             return False
         if meta.step <= self._persisted_step:
             return True
-        return self.save_step_checkpoint(meta.step)
+        return self.save_step_checkpoint(
+            meta.step, commit_timeout=self.BREAKPOINT_COMMIT_TIMEOUT
+        )
 
-    def save_step_checkpoint(self, step: int) -> bool:
+    def save_step_checkpoint(
+        self, step: int, commit_timeout: Optional[float] = None
+    ) -> bool:
+        # Snapshot the world ONCE: ``set_world`` (agent thread, new
+        # rendezvous) can mutate num_hosts/world_hosts mid-persist, and a
+        # torn read would pair host_i_of_4.meta with host_i_of_2.data or
+        # mis-stamp the done marker.
+        num_hosts = self.num_hosts
+        world_hosts = list(self.world_hosts) if self.world_hosts else None
+        # Committer election must use the SAME snapshot: a rendezvous landing
+        # between persist and commit must not elect nobody (newest step never
+        # committed) or two committers.
+        is_committer = (
+            self.host_index == min(world_hosts) if world_hosts
+            else self.host_index == 0
+        )
         # Hold the shm lock for the whole read so the trainer cannot
         # overwrite the arena mid-persist (it skips the save instead).
         if not self._lock.acquire(blocking=True):
@@ -184,15 +210,25 @@ class AsyncCheckpointSaver:
             t0 = time.monotonic()
             step_dir = self.layout.step_dir(step)
             self.storage.safe_makedirs(step_dir)
+            if step not in self._cleaned_steps:
+                self._clean_stale_host_files(step, num_hosts, world_hosts)
+                self._cleaned_steps.add(step)
             self.storage.write(
                 pickle.dumps(meta),
-                self.layout.meta_path(step, self.host_index, self.num_hosts),
+                self.layout.meta_path(step, self.host_index, num_hosts),
             )
             self.storage.write(
                 bytes(self._shm.raw_data(meta)),
-                self.layout.data_path(step, self.host_index, self.num_hosts),
+                self.layout.data_path(step, self.host_index, num_hosts),
             )
-            self.storage.write("ok", self.layout.done_path(step, self.host_index))
+            # The done marker is world-stamped: the commit barrier only
+            # counts markers carrying the sealed world's size, so a stale
+            # done file left by a previous world's persist of the same step
+            # (same host id, different world) can never satisfy the barrier.
+            self.storage.write(
+                self._done_stamp(num_hosts),
+                self.layout.done_path(step, self.host_index),
+            )
             logger.info(
                 "host %d persisted step %d in %.2fs",
                 self.host_index, step, time.monotonic() - t0,
@@ -201,16 +237,12 @@ class AsyncCheckpointSaver:
             self._lock.release()
         self._persisted_step = step
         self._status.set("persisted_step", step)
-        if self._is_committer():
-            # Snapshot the sealed world NOW: a rendezvous shrink arriving
-            # mid-commit (set_world from the agent thread) must not lower
-            # the bar and let an incomplete step commit.
+        if is_committer:
             self.commit_checkpoint(
                 step,
-                expected_hosts=(
-                    list(self.world_hosts) if self.world_hosts else None
-                ),
-                num_hosts=self.num_hosts,
+                expected_hosts=world_hosts,
+                num_hosts=num_hosts,
+                timeout=commit_timeout,
             )
         return True
 
@@ -227,25 +259,84 @@ class AsyncCheckpointSaver:
             return self.host_index == min(self.world_hosts)
         return self.host_index == 0
 
-    def _count_done_files(self, step: int) -> int:
-        """Count per-host done markers by listing the step dir.
+    @staticmethod
+    def _done_stamp(num_hosts: int) -> str:
+        return f"ok:{num_hosts}"
+
+    def _done_matches(self, step: int, host: int, num_hosts: int) -> bool:
+        content = self.storage.read(
+            self.layout.done_path(step, host), mode="r"
+        )
+        return content is not None and content.strip() == self._done_stamp(
+            num_hosts
+        )
+
+    def _clean_stale_host_files(
+        self, step: int, num_hosts: int, world_hosts: Optional[list]
+    ):
+        """Drop host files a *previous* world left in this step dir.
+
+        Re-saving a step after an elastic membership change must not leave
+        the old world's ``host_*`` files behind: restore would see metas
+        from mixed world sizes and reject the step, and stale done markers
+        could trip the commit barrier early.  Only files provably foreign to
+        the current world are deleted — peers of the current world write
+        their own files concurrently and those must never be touched.
+        Without a sealed world nothing is provably foreign (a pre-rendezvous
+        SIGTERM persist would otherwise shred live peers' files whose n
+        differs from this host's stale ``num_hosts``), so no cleanup runs.
+        """
+        if not world_hosts:
+            return
+        expected = set(world_hosts)
+        step_dir = self.layout.step_dir(step)
+        for name in self.storage.listdir(step_dir):
+            if not name.startswith("host_"):
+                continue
+            stale = False
+            try:
+                if name.endswith(".done"):
+                    host = int(name[len("host_"):].split(".")[0])
+                    stale = host not in expected
+                elif name.endswith((".meta", ".data")):
+                    host = int(name[len("host_"):].split("_of_")[0])
+                    file_n = int(name.split("_of_")[1].split(".")[0])
+                    stale = file_n != num_hosts or host not in expected
+            except (IndexError, ValueError):
+                continue
+            if stale:
+                self.storage.remove(os.path.join(step_dir, name))
+                logger.info(
+                    "step %d: removed stale %s from a previous world",
+                    step, name,
+                )
+
+    def _count_done_files(self, step: int, num_hosts: int) -> int:
+        """Count per-host done markers carrying the current world stamp.
 
         Node ids are sparse after elastic shrinks (e.g. hosts {0, 2} in a
         2-host world), so enumerating ``range(num_hosts)`` would wait for
-        ``host_1.done`` forever; only the *count* of distinct done files is
-        meaningful.
+        ``host_1.done`` forever; only the *count* of distinct, correctly
+        world-stamped done files is meaningful.
         """
-        return sum(
-            1
-            for name in self.storage.listdir(self.layout.step_dir(step))
-            if name.startswith("host_") and name.endswith(".done")
-        )
+        count = 0
+        for name in self.storage.listdir(self.layout.step_dir(step)):
+            if not (name.startswith("host_") and name.endswith(".done")):
+                continue
+            try:
+                host = int(name[len("host_"):].split(".")[0])
+            except ValueError:
+                continue
+            if self._done_matches(step, host, num_hosts):
+                count += 1
+        return count
 
     def commit_checkpoint(
         self,
         step: int,
         expected_hosts: Optional[list] = None,
         num_hosts: Optional[int] = None,
+        timeout: Optional[float] = None,
     ):
         """The committer waits for every sealed-world host's done-file, then
         flips the tracker.  ``expected_hosts``/``num_hosts`` are snapshots of
@@ -254,15 +345,21 @@ class AsyncCheckpointSaver:
         need = len(expected_hosts) if expected_hosts else (
             num_hosts if num_hosts is not None else self.num_hosts
         )
-        deadline = time.monotonic() + self.commit_timeout
+        deadline = time.monotonic() + (
+            self.commit_timeout if timeout is None else timeout
+        )
+        # A stamp that matched once stays valid for this barrier's snapshot
+        # — cache matches so the poll loop does one read per host, not one
+        # per host per 0.5s tick (matters on object-store mounts).
+        matched: set = set()
         while time.monotonic() < deadline:
             if expected_hosts:
-                done = sum(
-                    self.storage.exists(self.layout.done_path(step, h))
-                    for h in expected_hosts
-                )
+                for h in expected_hosts:
+                    if h not in matched and self._done_matches(step, h, need):
+                        matched.add(h)
+                done = len(matched)
             else:
-                done = self._count_done_files(step)
+                done = self._count_done_files(step, need)
             if done >= need:
                 self.storage.write(str(step), self.layout.tracker_path())
                 self.storage.commit(step, True)

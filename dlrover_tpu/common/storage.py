@@ -74,6 +74,10 @@ class CheckpointStorage(ABC):
     @abstractmethod
     def listdir(self, path: str) -> List[str]: ...
 
+    @abstractmethod
+    def remove(self, path: str) -> None:
+        """Delete one file; missing files are not an error."""
+
     def commit(self, step: int, success: bool) -> None:
         """Hook called once a step's files are all durable."""
 
@@ -107,6 +111,12 @@ class PosixDiskStorage(CheckpointStorage):
 
     def listdir(self, path: str) -> List[str]:
         return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+    def remove(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
 
 
 def get_checkpoint_storage(

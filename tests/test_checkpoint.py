@@ -344,3 +344,28 @@ def test_lock_release_requires_owner_and_steals_from_dead(tmp_path):
     t.start(); t.join()
     assert other == [True]
     server.close()
+
+
+@pytest.mark.slow
+def test_flash_save_gb_scale_is_subsecond():
+    """The Flash Checkpoint headline (BASELINE.md: 151s -> 0.5s saves) rests
+    on the shm memcpy being fast: a ~1 GiB state must block the trainer for
+    well under a second (round-2 verdict: measure it, don't assert it)."""
+    import time
+
+    state = {
+        f"w{i}": np.ones((64, 1024, 1024), np.float32) for i in range(4)
+    }  # 4 x 256 MiB = 1 GiB
+    handler = SharedMemoryHandler(f"gb{os.getpid()}")
+    try:
+        handler.save_state_dict(state, step=1)  # first call sizes the arena
+        t0 = time.perf_counter()
+        handler.save_state_dict(state, step=2)
+        dt = time.perf_counter() - t0
+        gib = 2**30
+        print(f"shm save of 1 GiB took {dt:.3f}s ({1 / max(dt, 1e-9):.1f} GiB/s)")
+        assert dt < 1.0, f"1 GiB shm save took {dt:.2f}s (>1s)"
+        meta = handler.load_meta()
+        assert meta.step == 2
+    finally:
+        handler.close(unlink=True)

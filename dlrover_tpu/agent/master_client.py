@@ -115,6 +115,13 @@ class MasterClient:
     def num_nodes_waiting(self, rdzv_name: str = "elastic-training") -> int:
         return self.get(msg.WaitingNodesRequest(rdzv_name)).payload
 
+    def world_changed(
+        self, round_: int, rdzv_name: str = "elastic-training"
+    ) -> bool:
+        return bool(
+            self.get(msg.WorldChangedRequest(round_, rdzv_name)).payload
+        )
+
     def report_network_status(
         self, node_rank: int, normal: bool, elapsed: float
     ):
@@ -158,6 +165,20 @@ class MasterClient:
 
     def report_heartbeat(self, diagnosis: Optional[Dict] = None):
         self.report(msg.HeartBeat(self.node_id, diagnosis=diagnosis or {}))
+
+    def report_resource(
+        self,
+        cpu_percent: float,
+        mem_gb: float,
+        device_mem_gb: float = 0.0,
+        device_util: float = 0.0,
+    ):
+        self.report(
+            msg.ResourceStats(
+                self.node_id, cpu_percent, mem_gb,
+                device_mem_gb, device_util,
+            )
+        )
 
     def report_failure(
         self, error: str, exit_code: int = 1, level: str = "process",

@@ -1,0 +1,133 @@
+"""Agent-side resource monitor: host cpu/mem + trainer-reported device HBM.
+
+Capability ref: ``dlrover/python/elastic_agent/monitor/resource.py:86-180``
+(``ResourceMonitor`` sampling cpu/mem/gpu and reporting to the master) and
+``monitor/training.py`` (metrics handed over through a file the trainer
+writes — on TPU only the trainer process can read its devices'
+``memory_stats()``, so the same file seam carries HBM numbers out).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def read_cpu_times() -> Tuple[float, float]:
+    """(busy_jiffies, total_jiffies) from /proc/stat."""
+    with open("/proc/stat") as f:
+        fields = f.readline().split()[1:]
+    values = [float(v) for v in fields]
+    idle = values[3] + (values[4] if len(values) > 4 else 0.0)
+    total = sum(values)
+    return total - idle, total
+
+
+def read_mem_gb() -> float:
+    """Used host memory (total - available) in GiB."""
+    info = {}
+    with open("/proc/meminfo") as f:
+        for line in f:
+            key, _, rest = line.partition(":")
+            info[key] = float(rest.split()[0])  # kB
+    used_kb = info.get("MemTotal", 0.0) - info.get("MemAvailable", 0.0)
+    return used_kb / 2**20
+
+
+def write_device_metrics(path: Optional[str] = None) -> Optional[Dict]:
+    """Trainer-side half: dump local device HBM stats for the agent.
+
+    Call periodically from the training loop (cheap).  Returns the stats
+    dict, or None when no metrics file is configured and no path given.
+    """
+    from dlrover_tpu.common.constants import ConfigKey
+
+    path = path or os.environ.get(ConfigKey.METRICS_FILE)
+    if not path:
+        return None
+    import jax
+
+    bytes_used = peak = limit = 0
+    for device in jax.local_devices():
+        stats = device.memory_stats() or {}
+        bytes_used += stats.get("bytes_in_use", 0)
+        peak += stats.get("peak_bytes_in_use", 0)
+        limit += stats.get("bytes_limit", 0)
+    payload = {
+        "device_mem_gb": bytes_used / 2**30,
+        "device_peak_gb": peak / 2**30,
+        "device_util": (bytes_used / limit) if limit else 0.0,
+        "timestamp": time.time(),
+    }
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        logger.debug("device metrics write failed: %s", e)
+    return payload
+
+
+class ResourceMonitor:
+    """Samples host + device telemetry and reports it to the master."""
+
+    def __init__(self, client, interval: float = 30.0,
+                 metrics_file: Optional[str] = None):
+        self._client = client
+        self._interval = interval
+        self._metrics_file = metrics_file
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_cpu: Optional[Tuple[float, float]] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def sample(self) -> Dict[str, float]:
+        busy, total = read_cpu_times()
+        cpu_percent = 0.0
+        if self._last_cpu is not None:
+            dbusy = busy - self._last_cpu[0]
+            dtotal = total - self._last_cpu[1]
+            if dtotal > 0:
+                cpu_percent = 100.0 * dbusy / dtotal
+        self._last_cpu = (busy, total)
+        out = {"cpu_percent": cpu_percent, "mem_gb": read_mem_gb(),
+               "device_mem_gb": 0.0, "device_util": 0.0}
+        if self._metrics_file and os.path.exists(self._metrics_file):
+            try:
+                with open(self._metrics_file) as f:
+                    device = json.load(f)
+                out["device_mem_gb"] = float(device.get("device_mem_gb", 0.0))
+                out["device_util"] = float(device.get("device_util", 0.0))
+            except (OSError, ValueError):
+                pass
+        return out
+
+    def _run(self):
+        self.sample()  # prime the cpu delta
+        while not self._stop.wait(self._interval):
+            try:
+                s = self.sample()
+                self._client.report_resource(
+                    s["cpu_percent"], s["mem_gb"],
+                    s["device_mem_gb"], s["device_util"],
+                )
+            except ConnectionError:
+                logger.warning("resource report: master unreachable")
+            except Exception as e:  # noqa: BLE001 - telemetry must not kill
+                logger.warning("resource monitor error: %s", e)

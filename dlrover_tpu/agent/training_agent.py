@@ -77,6 +77,8 @@ class ElasticLaunchConfig:
     checkpoint_dir: str = ""
     rdzv_timeout: float = 600.0
     local_world_size: int = 0  # 0 -> discover (local chip count)
+    heartbeat_interval: float = 15.0
+    resource_report_interval: float = 30.0
 
 
 class RunResult(Enum):
@@ -167,6 +169,15 @@ class ElasticAgent:
         self._stop = threading.Event()
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
+        self._resource_monitor = None
+
+    def _metrics_file(self) -> str:
+        """Trainer->agent device-telemetry handoff file (ref
+        ``monitor/training.py`` metrics-file seam)."""
+        from dlrover_tpu.common.multi_process import socket_dir
+
+        os.makedirs(socket_dir(), exist_ok=True)
+        return os.path.join(socket_dir(), f"metrics_n{self.node_id}.json")
 
     # -- worker lifecycle -----------------------------------------------------
 
@@ -182,6 +193,7 @@ class ElasticAgent:
                 ENV_NUM_PROC: str(len(rdzv["world"])),
                 ENV_PROC_ID: str(rdzv["rank"]),
                 ENV_RESTART_COUNT: str(self._restart_count),
+                ConfigKey.METRICS_FILE: self._metrics_file(),
             }
         )
         logger.info(
@@ -217,10 +229,15 @@ class ElasticAgent:
 
     def _membership_changed(self) -> bool:
         """ref ``_membership_changed:694``: nodes waiting to join (scale-up)
-        or the formed world advanced past our round (a member left)."""
+        or the formed world advanced past our round / lost a member
+        (scale-down, peer death)."""
         try:
             waiting = self.client.num_nodes_waiting(RendezvousName.TRAINING)
-            return waiting > 0
+            if waiting > 0:
+                return True
+            return self.client.world_changed(
+                self._current_round, RendezvousName.TRAINING
+            )
         except ConnectionError:
             return False
 
@@ -251,7 +268,7 @@ class ElasticAgent:
                 self.client.report_heartbeat()
             except ConnectionError:
                 logger.warning("heartbeat: master unreachable")
-            self._stop.wait(15.0)
+            self._stop.wait(self.config.heartbeat_interval)
 
     # -- main loop ------------------------------------------------------------
 
@@ -270,6 +287,14 @@ class ElasticAgent:
             target=self._heartbeat_loop, name="agent-heartbeat", daemon=True
         )
         self._heartbeat_thread.start()
+        from dlrover_tpu.agent.monitor import ResourceMonitor
+
+        self._resource_monitor = ResourceMonitor(
+            self.client,
+            interval=self.config.resource_report_interval,
+            metrics_file=self._metrics_file(),
+        )
+        self._resource_monitor.start()
         self._start_workers()
         result = self._invoke_run()
         self._stop.set()
@@ -283,6 +308,11 @@ class ElasticAgent:
                 if self._membership_changed():
                     logger.info("membership changed: restarting with new world")
                     self.client.report_event("restarting", "membership change")
+                    # Persist the trainer's latest shm checkpoint first: the
+                    # restarted world resumes from it (ref ``training.py:622``
+                    # save-ckpt-then-restart on membership change).
+                    if self._saver is not None:
+                        self._saver.save_shm_to_storage()
                     self._restart_workers()
                 continue
             if code == 0:
@@ -319,6 +349,8 @@ class ElasticAgent:
 
     def shutdown(self, job_succeeded: bool = False):
         self._stop.set()
+        if self._resource_monitor is not None:
+            self._resource_monitor.stop()
         self._stop_workers()
         if self._saver is not None:
             self._saver.stop(unlink_shm=job_succeeded)

@@ -92,6 +92,7 @@ class ConfigKey:
     NODE_NUM = "DLROVER_TPU_NODE_NUM"
     JOB_NAME = "DLROVER_TPU_JOB_NAME"
     PARAL_CONFIG_PATH = "DLROVER_TPU_PARAL_CONFIG_PATH"
+    METRICS_FILE = "DLROVER_TPU_METRICS_FILE"
     SHM_PREFIX = "DLROVER_TPU_SHM_PREFIX"
 
 

@@ -1,0 +1,140 @@
+"""Auto-scaling loop: observe, decide, actuate through the NodeLauncher.
+
+Capability ref: ``dlrover/python/master/node/job_auto_scaler.py:73-317``
+(``AllreduceTrainingAutoScaler._periodic_optimize_running_resource``) and the
+ScalePlan flow (``master/scaler/base_scaler.py``; the operator applies pod
+deltas — here the launcher seam does).
+
+TPU redesign: the schedulable unit is a host/slice, so a ScalePlan is just a
+desired host count (``node_unit``-aligned).  v1 policy:
+
+* **repair** — a host that died (heartbeat timeout / reported node failure)
+  is relaunched through the launcher while relaunch budget remains;
+* **target tracking** — a manual/planned target (``set_target``) is
+  converged on by launching or deleting hosts;
+* hooks for utilization-driven decisions read the MetricsCollector
+  (``mean_cpu``) and SpeedMonitor — the optimizer tier (reference Brain) can
+  plug in by calling ``set_target``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.metrics import MetricsCollector
+from dlrover_tpu.master.node_manager import NodeManager, NodeStatus
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+
+@dataclasses.dataclass
+class ScalePlan:
+    """One actuation decision (ref ScalePlan CRD, slice-granular)."""
+
+    target_nodes: int
+    launch: List[int] = dataclasses.field(default_factory=list)
+    delete: List[int] = dataclasses.field(default_factory=list)
+    reason: str = ""
+
+    @property
+    def empty(self) -> bool:
+        return not self.launch and not self.delete
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        node_manager: NodeManager,
+        speed_monitor: SpeedMonitor,
+        metrics: Optional[MetricsCollector] = None,
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        node_unit: int = 1,
+        cooldown_s: float = 30.0,
+        retire_hook: Optional[Callable[[int], None]] = None,
+    ):
+        self.node_manager = node_manager
+        self.speed_monitor = speed_monitor
+        self.metrics = metrics or MetricsCollector()
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.node_unit = max(1, node_unit)
+        self.cooldown_s = cooldown_s
+        # Called per retired node AFTER launcher teardown: the master wires
+        # rendezvous eviction + shard requeue here so survivors see the
+        # broken world and re-form instead of hanging in dead collectives.
+        self.retire_hook = retire_hook
+        self._target = max_nodes
+        self._last_scale = 0.0
+        self._lock = threading.Lock()
+        self.plans: deque = deque(maxlen=256)
+
+    def set_target(self, target_nodes: int, reason: str = "manual"):
+        """Request a new world size (node_unit-aligned, clamped to range)."""
+        aligned = max(
+            self.min_nodes,
+            min(self.max_nodes,
+                (target_nodes // self.node_unit) * self.node_unit),
+        )
+        with self._lock:
+            if aligned != self._target:
+                logger.info(
+                    "scale target %d -> %d (%s)", self._target, aligned, reason
+                )
+                self._target = aligned
+
+    @property
+    def target(self) -> int:
+        with self._lock:
+            return self._target
+
+    def decide(self) -> ScalePlan:
+        """Compare live inventory with the target; no side effects."""
+        statuses = self.node_manager.statuses()
+        live = [
+            n for n, s in statuses.items()
+            if s in (NodeStatus.RUNNING.value, NodeStatus.PENDING.value)
+        ]
+        target = self.target
+        plan = ScalePlan(target_nodes=target)
+        if len(live) < target:
+            # Repair/up-scale: (re)launch the lowest missing node ids whose
+            # relaunch budget remains (a permanently-failed node must not
+            # produce a futile plan every cooldown tick forever).
+            missing = [
+                n for n in range(self.max_nodes)
+                if n not in live and self.node_manager.relaunchable(n)
+            ][: target - len(live)]
+            plan.launch = missing
+            plan.reason = f"live {len(live)} < target {target}"
+        elif len(live) > target:
+            # Down-scale: retire the highest node ids (keeps rank-0 stable).
+            plan.delete = sorted(live, reverse=True)[: len(live) - target]
+            plan.reason = f"live {len(live)} > target {target}"
+        return plan
+
+    def step(self) -> Optional[ScalePlan]:
+        """One control-loop tick: decide and actuate (cooldown-limited)."""
+        now = time.monotonic()
+        if now - self._last_scale < self.cooldown_s:
+            return None
+        plan = self.decide()
+        if plan.empty:
+            return None
+        self._last_scale = now
+        self.plans.append(plan)
+        logger.info(
+            "scale plan: launch=%s delete=%s (%s)",
+            plan.launch, plan.delete, plan.reason,
+        )
+        for node_id in plan.launch:
+            self.node_manager.launch_node(node_id)
+        for node_id in plan.delete:
+            self.node_manager.retire_node(node_id)
+            if self.retire_hook is not None:
+                self.retire_hook(node_id)
+        return plan

@@ -13,7 +13,9 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.auto_scaler import JobAutoScaler
 from dlrover_tpu.master.kv_store import KVStore
+from dlrover_tpu.master.metrics import MetricsCollector
 from dlrover_tpu.master.node_manager import NodeLauncher, NodeManager
 from dlrover_tpu.master.rdzv_manager import (
     ElasticTrainingRendezvousManager,
@@ -35,21 +37,41 @@ class JobMaster:
         node_unit: int = 1,
         launcher: Optional[NodeLauncher] = None,
         max_relaunches: int = 3,
+        min_nodes: int = 0,
+        rdzv_waiting_timeout: float = 60.0,
+        heartbeat_timeout: float = 0.0,
+        hang_threshold: float = 0.0,
+        auto_scale: bool = True,
     ):
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager()
         self.kv_store = KVStore()
+        self.metrics = MetricsCollector()
         self.node_manager = NodeManager(
             num_nodes=num_nodes,
             launcher=launcher,
             max_relaunches=max_relaunches,
+            heartbeat_timeout=heartbeat_timeout,
         )
+        self.auto_scaler = JobAutoScaler(
+            self.node_manager,
+            self.speed_monitor,
+            metrics=self.metrics,
+            min_nodes=min_nodes or num_nodes,
+            max_nodes=num_nodes,
+            node_unit=node_unit,
+            retire_hook=self._handle_node_retired,
+        ) if auto_scale else None
+        # Hang remediation (ref CheckTrainingHangOperator +
+        # atorch HangingDetector): 0 disables.
+        self.hang_threshold = hang_threshold
+        self._last_hang_fix = 0.0
         elastic = ElasticTrainingRendezvousManager()
         netcheck = NetworkCheckRendezvousManager()
         for manager in (elastic, netcheck):
             manager.update_rdzv_params(
-                min_nodes=num_nodes, max_nodes=num_nodes,
-                waiting_timeout=60.0, node_unit=node_unit,
+                min_nodes=min_nodes or num_nodes, max_nodes=num_nodes,
+                waiting_timeout=rdzv_waiting_timeout, node_unit=node_unit,
             )
         self.rdzv_managers = {
             RendezvousName.TRAINING: elastic,
@@ -61,6 +83,7 @@ class JobMaster:
             node_manager=self.node_manager,
             speed_monitor=self.speed_monitor,
             kv_store=self.kv_store,
+            metrics=self.metrics,
         )
         self._server = None
         self.port = port
@@ -83,11 +106,62 @@ class JobMaster:
         """ref ``dist_master.py:211-269``: periodic health/housekeeping."""
         while not self._stop.is_set():
             try:
-                self.node_manager.check_heartbeats()
+                newly_dead = self.node_manager.check_heartbeats()
+                for node_id in newly_dead:
+                    self._handle_node_death(node_id)
                 self.task_manager.reassign_timeout_tasks()
+                if self.auto_scaler is not None:
+                    self.auto_scaler.step()
+                self._check_training_hang()
             except Exception as e:
                 logger.warning("master control loop error: %s", e)
             self._stop.wait(self.CONTROL_LOOP_INTERVAL)
+
+    def _check_training_hang(self):
+        """Act on a stalled job (ref ``check_training_hang_operator.py:26``,
+        atorch ``hanging_detector.py:86-137``): when no step has advanced
+        for ``hang_threshold`` seconds, break the sealed world so every
+        agent checkpoints and restarts its trainer."""
+        if not self.hang_threshold:
+            return
+        sm = self.speed_monitor
+        if sm.global_step == 0:
+            return  # still initializing; rendezvous timeouts cover this
+        stalled = sm.no_progress_for()
+        now = time.monotonic()
+        if (
+            stalled > self.hang_threshold
+            and now - self._last_hang_fix > self.hang_threshold
+        ):
+            self._last_hang_fix = now
+            logger.error(
+                "training hang: no step for %.0fs (> %.0fs); forcing a "
+                "world restart", stalled, self.hang_threshold,
+            )
+            for manager in self.rdzv_managers.values():
+                manager.invalidate_world()
+            self.speed_monitor.reset_running_speed()
+
+    def _handle_node_death(self, node_id: int):
+        """Silent host death (heartbeat timeout) gets the same recovery as a
+        reported failure (ref ``dist_job_manager.py:355-400``): evict it from
+        every rendezvous so survivors see the broken world and re-form,
+        requeue its unfinished data shards, reset the speed window."""
+        logger.warning("node %d declared dead (heartbeat timeout)", node_id)
+        for manager in self.rdzv_managers.values():
+            manager.remove_alive_node(node_id)
+        self.task_manager.recover_tasks(node_id)
+        self.speed_monitor.reset_running_speed()
+        if self.auto_scaler is None:
+            # No scaler repair loop: relaunch directly (budget-limited).
+            self.node_manager.launch_node(node_id)
+
+    def _handle_node_retired(self, node_id: int):
+        """Scale-down teardown: survivors must see the broken world and
+        re-form (otherwise their trainers hang in dead collectives)."""
+        for manager in self.rdzv_managers.values():
+            manager.remove_alive_node(node_id)
+        self.task_manager.recover_tasks(node_id)
 
     def stop(self):
         self._stop.set()
@@ -121,10 +195,13 @@ def main():  # python -m dlrover_tpu.master.job_master --port N --nodes N
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--nodes", type=int, default=1)
+    parser.add_argument("--min-nodes", type=int, default=0)
     parser.add_argument("--node-unit", type=int, default=1)
+    parser.add_argument("--heartbeat-timeout", type=float, default=0.0)
     args = parser.parse_args()
     master = JobMaster(
-        port=args.port, num_nodes=args.nodes, node_unit=args.node_unit
+        port=args.port, num_nodes=args.nodes, node_unit=args.node_unit,
+        min_nodes=args.min_nodes, heartbeat_timeout=args.heartbeat_timeout,
     )
     master.start()
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)

@@ -78,6 +78,14 @@ class WaitingNodesRequest:
 
 
 @dataclasses.dataclass
+class WorldChangedRequest:
+    """Has the world sealed at ``round`` been superseded or broken?"""
+
+    round: int
+    rdzv_name: str = "elastic-training"
+
+
+@dataclasses.dataclass
 class NetworkCheckResultRequest:
     node_rank: int = -1
 

@@ -1,0 +1,78 @@
+"""Master-side metrics store: node resource time series + job aggregates.
+
+Capability ref: ``dlrover/python/master/stats/job_collector.py`` +
+``stats/reporter.py`` (JobMetricCollector with a local reporter; the Brain/
+MySQL tier is out of scope — the seam is the collector interface).  This is
+the auto-scaler's and diagnosis subsystem's data source.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+class MetricsCollector:
+    """Bounded per-node time series of reported resource stats."""
+
+    WINDOW = 120  # samples per node (~1h at 30s cadence)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # node_id -> deque[(ts, cpu%, mem_gb, device_mem_gb, device_util)]
+        self._series: Dict[int, Deque[Tuple[float, float, float, float, float]]] = {}
+
+    def collect(
+        self,
+        node_id: int,
+        cpu_percent: float,
+        mem_gb: float,
+        device_mem_gb: float = 0.0,
+        device_util: float = 0.0,
+        timestamp: Optional[float] = None,
+    ):
+        ts = timestamp or time.time()
+        with self._lock:
+            series = self._series.setdefault(
+                node_id, deque(maxlen=self.WINDOW)
+            )
+            series.append((ts, cpu_percent, mem_gb, device_mem_gb, device_util))
+
+    def latest(self, node_id: int) -> Optional[Dict[str, float]]:
+        with self._lock:
+            series = self._series.get(node_id)
+            if not series:
+                return None
+            ts, cpu, mem, dmem, dutil = series[-1]
+            return {
+                "timestamp": ts,
+                "cpu_percent": cpu,
+                "mem_gb": mem,
+                "device_mem_gb": dmem,
+                "device_util": dutil,
+            }
+
+    def nodes(self) -> List[int]:
+        with self._lock:
+            return sorted(self._series)
+
+    def mean_cpu(self, window_s: float = 300.0) -> float:
+        """Mean cpu%% across nodes over the recent window (scaler input)."""
+        cutoff = time.time() - window_s
+        values = []
+        with self._lock:
+            for series in self._series.values():
+                values.extend(c for ts, c, *_ in series if ts >= cutoff)
+        return sum(values) / len(values) if values else 0.0
+
+    def stale_nodes(self, max_age_s: float) -> List[int]:
+        """Nodes whose newest sample is older than ``max_age_s``."""
+        now = time.time()
+        out = []
+        with self._lock:
+            for node_id, series in self._series.items():
+                if series and now - series[-1][0] > max_age_s:
+                    out.append(node_id)
+        return sorted(out)

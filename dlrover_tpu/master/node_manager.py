@@ -58,6 +58,63 @@ class NodeLauncher:
         logger.info("launcher: (noop) delete node %d", node_id)
 
 
+class LocalNodeLauncher(NodeLauncher):
+    """Subprocess-spawning launcher: each "host" is a local agent process.
+
+    The local stand-in for the reference's pod scaler
+    (ref ``dlrover/python/master/scaler/pod_scaler.py:78-662``): tests and
+    the goodput harness exercise real host relaunch — a launched node is a
+    ``dlrover_tpu.run`` agent subprocess in its own process group.
+    ``command_builder(node_id) -> argv`` supplies the agent command line.
+    """
+
+    def __init__(self, command_builder, env: Optional[dict] = None):
+        import os
+
+        self._command_builder = command_builder
+        self._env = dict(env) if env is not None else dict(os.environ)
+        self.procs: Dict[int, "subprocess.Popen"] = {}
+
+    def launch(self, node_id: int) -> None:
+        import subprocess
+
+        existing = self.procs.get(node_id)
+        if existing is not None and existing.poll() is None:
+            logger.info("launcher: node %d already running", node_id)
+            return
+        self.procs[node_id] = subprocess.Popen(
+            self._command_builder(node_id),
+            env=self._env,
+            start_new_session=True,
+        )
+        logger.info(
+            "launcher: spawned node %d (pid %d)",
+            node_id, self.procs[node_id].pid,
+        )
+
+    def delete(self, node_id: int) -> None:
+        import os
+        import signal
+        import subprocess
+
+        proc = self.procs.pop(node_id, None)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=5)
+        except ProcessLookupError:
+            pass
+        logger.info("launcher: deleted node %d", node_id)
+
+    def shutdown(self):
+        for node_id in list(self.procs):
+            self.delete(node_id)
+
+
 class NodeManager:
     HEARTBEAT_TIMEOUT = 300.0
 
@@ -66,7 +123,10 @@ class NodeManager:
         num_nodes: int = 1,
         launcher: Optional[NodeLauncher] = None,
         max_relaunches: int = 3,
+        heartbeat_timeout: float = 0.0,
     ):
+        if heartbeat_timeout:
+            self.HEARTBEAT_TIMEOUT = heartbeat_timeout
         self._lock = threading.Lock()
         self._nodes: Dict[int, NodeState] = {
             i: NodeState(i, max_relaunches) for i in range(num_nodes)
@@ -163,9 +223,54 @@ class NodeManager:
         self._transition(node, NodeStatus.PENDING)
         return True
 
+    def relaunchable(self, node_id: int) -> bool:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            return node is None or node.relaunch_count < node.max_relaunches
+
+    def launch_node(self, node_id: int) -> bool:
+        """Scaler entry: (re)launch a host if its relaunch budget remains.
+
+        The launcher call itself runs OUTSIDE the lock — a real launcher
+        (cloud API, subprocess teardown) can block for seconds and every
+        heartbeat/event RPC contends on this lock.
+        """
+        with self._lock:
+            node = self.ensure_node(node_id)
+            if node.status in (NodeStatus.RUNNING, NodeStatus.PENDING):
+                return True
+            if node.relaunch_count >= node.max_relaunches:
+                logger.warning(
+                    "node %d relaunch budget exhausted", node_id
+                )
+                return False
+            node.relaunch_count += 1
+            node.last_heartbeat = time.time()
+            self._transition(node, NodeStatus.PENDING)
+        try:
+            self._launcher.launch(node_id)
+        except Exception as e:  # noqa: BLE001 - cloud APIs fail transiently
+            logger.error("launch of node %d failed: %s", node_id, e)
+            with self._lock:
+                self._transition(self.ensure_node(node_id), NodeStatus.DEAD)
+            return False
+        return True
+
+    def retire_node(self, node_id: int):
+        """Scaler entry: remove a host from the job (scale-down); launcher
+        teardown (possibly seconds) runs outside the lock."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            self._transition(node, NodeStatus.SUCCEEDED)
+        self._launcher.delete(node_id)
+
     def check_heartbeats(self) -> List[int]:
         """Mark hosts with stale heartbeats dead; returns newly-dead ids
-        (ref ``_monitor_node_heart_beat:355``, 300s window)."""
+        (ref ``_monitor_node_heart_beat:355``, 300s window).  Relaunching is
+        the caller's decision (JobMaster death handler or the auto-scaler's
+        repair loop) — doing it here too would double-spend the budget."""
         newly_dead = []
         now = time.time()
         with self._lock:
@@ -174,7 +279,6 @@ class NodeManager:
                     if now - node.last_heartbeat > self.HEARTBEAT_TIMEOUT:
                         self._transition(node, NodeStatus.DEAD)
                         newly_dead.append(node.node_id)
-                        self._maybe_relaunch(node)
         return newly_dead
 
     def statuses(self) -> Dict[int, str]:

@@ -56,6 +56,10 @@ class RendezvousManager(ABC):
         self._node_unit = 1
         self._alive_nodes: set = set()
         self._scale_down_ts = 0.0
+        # True while the latest sealed world has lost a member: survivors
+        # polling ``world_changed`` must restart and re-join so a smaller
+        # world can seal (the scale-down half of membership detection).
+        self._world_broken = False
 
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int,
@@ -76,10 +80,29 @@ class RendezvousManager(ABC):
             if node_rank in self._waiting_nodes:
                 del self._waiting_nodes[node_rank]
             if node_rank in self._rdzv_nodes:
-                # A member died: the next join must re-form the world.
+                # A member died: survivors must learn the world is broken and
+                # re-join so the next round seals without the dead node.
+                self._world_broken = True
                 logger.info(
-                    "%s: node %d left the formed world", self.name, node_rank
+                    "%s: node %d left the formed world (round %d broken)",
+                    self.name, node_rank, self._rdzv_round,
                 )
+
+    def invalidate_world(self):
+        """Force a re-form of the current sealed world (hang remediation):
+        members polling ``world_changed`` restart and re-join."""
+        with self._lock:
+            if self._rdzv_nodes:
+                self._world_broken = True
+
+    def world_changed(self, round_: int) -> bool:
+        """True when the world an agent joined at ``round_`` no longer holds:
+        a newer round sealed past it, or a member of the current round died.
+        This is the scale-down/death half of membership-change detection (the
+        scale-up half is ``num_nodes_waiting``); capability ref
+        ``dlrover/python/elastic_agent/torch/training.py:694``."""
+        with self._lock:
+            return self._rdzv_round > round_ or self._world_broken
 
     def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
         """Register a host; returns the round it will join."""
@@ -123,6 +146,7 @@ class RendezvousManager(ABC):
         for rank in members:
             del self._waiting_nodes[rank]
         self._rdzv_round += 1
+        self._world_broken = False
         logger.info(
             "%s: round %d sealed with %d nodes (%.1fs to form)",
             self.name, self._rdzv_round, len(self._rdzv_nodes),

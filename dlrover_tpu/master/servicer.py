@@ -35,6 +35,7 @@ class MasterServicer:
         speed_monitor=None,
         kv_store=None,
         paral_config=None,
+        metrics=None,
     ):
         self.rdzv_managers = rdzv_managers or {}
         self.task_manager = task_manager
@@ -42,9 +43,11 @@ class MasterServicer:
         self.speed_monitor = speed_monitor
         self.kv_store = kv_store
         self.paral_config = paral_config or msg.ParalConfig()
+        self.metrics = metrics
         self._get_handlers: Dict[Type, Callable] = {
             msg.CommWorldRequest: self._get_comm_world,
             msg.WaitingNodesRequest: self._get_waiting_nodes,
+            msg.WorldChangedRequest: self._get_world_changed,
             msg.TaskRequest: self._get_task,
             msg.KVGet: self._kv_get,
             msg.KVAdd: self._kv_add,
@@ -115,6 +118,10 @@ class MasterServicer:
     def _get_waiting_nodes(self, env: msg.Envelope):
         manager = self.rdzv_managers[env.payload.rdzv_name]
         return manager.num_nodes_waiting()
+
+    def _get_world_changed(self, env: msg.Envelope):
+        p: msg.WorldChangedRequest = env.payload
+        return self.rdzv_managers[p.rdzv_name].world_changed(p.round)
 
     def _report_network_status(self, env: msg.Envelope):
         p: msg.NetworkStatus = env.payload
@@ -197,7 +204,12 @@ class MasterServicer:
             self.node_manager.report_event(p.node_id, p.event, p.detail)
 
     def _report_resource(self, env: msg.Envelope):
-        pass  # recorded by metric collector (auto-scaler input)
+        p: msg.ResourceStats = env.payload
+        if self.metrics is not None:
+            self.metrics.collect(
+                p.node_id, p.cpu_percent, p.mem_gb,
+                p.device_mem_gb, p.device_util,
+            )
 
     def _get_job_status(self, env: msg.Envelope):
         return msg.JobStatus(

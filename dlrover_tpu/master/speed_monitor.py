@@ -40,7 +40,9 @@ class SpeedMonitor:
                 # Time between consecutive step reports counts as productive
                 # as long as steps keep advancing.
                 self._productive_s += ts - self._last_step_time
-            else:
+            elif self._first_step_time is None:
+                # Only the job's FIRST step starts the training phase —
+                # post-restart reports must not move it (goodput basis).
                 self._first_step_time = ts
             self._last_step_time = ts
             self._global_step = step

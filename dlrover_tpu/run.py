@@ -43,6 +43,7 @@ def _parse_args(argv: Optional[List[str]] = None):
                         help="world size must be a multiple of this (slice size)")
     parser.add_argument("--max-restarts", type=int, default=3)
     parser.add_argument("--monitor-interval", type=float, default=5.0)
+    parser.add_argument("--heartbeat-interval", type=float, default=15.0)
     parser.add_argument("--network-check", action="store_true")
     parser.add_argument("--save-at-breakpoint", action="store_true")
     parser.add_argument("--checkpoint-dir", default="")
@@ -64,13 +65,14 @@ def _parse_nnodes(spec: str) -> Tuple[int, int]:
     return n, n
 
 
-def _launch_local_master(num_nodes: int, node_unit: int):
+def _launch_local_master(num_nodes: int, node_unit: int, min_nodes: int = 0):
     """Standalone mode: in-process master (ref
     ``_launch_dlrover_local_master`` ``elastic_run.py:344-351``)."""
     from dlrover_tpu.master.job_master import JobMaster
 
     master = JobMaster(
-        port=0, num_nodes=num_nodes, node_unit=node_unit
+        port=0, num_nodes=num_nodes, node_unit=node_unit,
+        min_nodes=min_nodes,
     )
     port = master.start()
     return master, f"localhost:{port}"
@@ -82,7 +84,7 @@ def run(argv: Optional[List[str]] = None) -> int:
     local_master = None
     if args.standalone or not args.master:
         local_master, master_addr = _launch_local_master(
-            max_nodes, args.node_unit
+            max_nodes, args.node_unit, min_nodes
         )
         logger.info("standalone master at %s", master_addr)
     else:
@@ -93,6 +95,7 @@ def run(argv: Optional[List[str]] = None) -> int:
         node_unit=args.node_unit,
         max_restarts=args.max_restarts,
         monitor_interval=args.monitor_interval,
+        heartbeat_interval=args.heartbeat_interval,
         network_check=args.network_check,
         save_at_breakpoint=args.save_at_breakpoint,
         checkpoint_dir=args.checkpoint_dir,

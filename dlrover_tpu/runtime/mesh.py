@@ -174,6 +174,16 @@ def _smallest_prime_factor(n: int) -> int:
     return n
 
 
+def mesh_axis_size(axis: str) -> int:
+    """Size of a named axis on the ambient (abstract) mesh; 1 when no mesh
+    is set or the axis is absent.  Model code gates explicit collectives
+    (Ulysses a2a, grouped-MoE dispatch) on this."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.axis_sizes)).get(axis, 1)
+
+
 def local_device_count() -> int:
     return jax.local_device_count()
 

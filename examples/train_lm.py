@@ -38,6 +38,13 @@ def parse_args():
     p.add_argument("--dataset-size", type=int, default=100000)
     p.add_argument("--fail-at-step", type=int, default=0,
                    help="test hook: crash at this step on first run")
+    p.add_argument("--step-sleep", type=float, default=0.0,
+                   help="test hook: slow steps down (chaos windows)")
+    p.add_argument("--auto-tune", action="store_true",
+                   help="search mesh/remat strategy before training "
+                        "(auto_accelerate equivalent)")
+    p.add_argument("--optimizer", default="adamw",
+                   help="adamw | adafactor | sgd | lion | q8_adam")
     return p.parse_args()
 
 
@@ -64,9 +71,22 @@ def main():
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
     )
-    mesh = build_mesh(ParallelConfig(data=-1))
+    if args.auto_tune:
+        from dlrover_tpu.auto import auto_tune
+
+        tuned = auto_tune(
+            cfg,
+            global_batch_size=args.batch_size,
+            seq_len=args.seq_len,
+            max_measure=2,
+        )
+        cfg = tuned.model_config
+        mesh = build_mesh(tuned.parallel)
+        logger.info("auto_tune picked %s", tuned.best.describe())
+    else:
+        mesh = build_mesh(ParallelConfig(data=-1))
     model = TransformerLM(cfg)
-    opt = train_lib.make_optimizer(learning_rate=1e-3)
+    opt = train_lib.make_optimizer(args.optimizer, learning_rate=1e-3)
     train = train_lib.build_sharded_train(
         model, opt, mesh, lr.DEFAULT_RULES,
         global_batch_size=args.batch_size, seq_len=args.seq_len,
@@ -92,12 +112,21 @@ def main():
             start_step = step
             logger.info("resumed from checkpoint at step %d", step)
 
+    # Each host's loader produces its local slice of the global batch;
+    # shard_batch assembles the global array from the per-process pieces.
+    n_proc = max(1, jax.process_count())
+    if args.batch_size % n_proc:
+        raise ValueError(
+            f"--batch-size {args.batch_size} must be divisible by the "
+            f"{n_proc}-host world"
+        )
+    local_batch = args.batch_size // n_proc
     if client is not None:
         loader_source = ShardingClient(
             client,
             "train",
             dataset_size=args.dataset_size,
-            shard_size=args.batch_size * 8,
+            shard_size=local_batch * 8,
             num_epochs=8,
             create=True,
         )
@@ -105,11 +134,12 @@ def main():
         loader_source = None
     loader = ElasticDataLoader(
         synthetic_lm_sample_fn(args.vocab, args.seq_len),
-        batch_size=args.batch_size,
+        batch_size=local_batch,
         source=loader_source,
     )
 
     step = start_step
+    last_saved = start_step
     t_start = time.monotonic()
     for batch in loader:
         if step >= args.steps:
@@ -121,6 +151,8 @@ def main():
             if renv.restart_count() == 0:
                 logger.error("test hook: crashing at step %d", step)
                 os._exit(17)
+        if args.step_sleep:
+            time.sleep(args.step_sleep)
         if step % 5 == 0 or step == args.steps:
             loss = float(metrics["loss"])
             logger.info("step %d loss %.4f", step, loss)
@@ -130,12 +162,23 @@ def main():
                     tokens=args.batch_size * args.seq_len * 5,
                     loss=loss,
                 )
+            from dlrover_tpu.agent.monitor import write_device_metrics
+
+            write_device_metrics()  # HBM telemetry for the agent monitor
         if ckpt is not None and (
             step % args.ckpt_every == 0 or step == args.steps
         ):
             from dlrover_tpu.checkpoint import StorageType
 
             ckpt.save_checkpoint(step, state, StorageType.DISK)
+            last_saved = step
+    if ckpt is not None and last_saved < step:
+        # A restart can resume at (or past) the final step with the newest
+        # state only in the previous world's uncommitted files — the final
+        # state must still be persisted and committed under THIS world.
+        from dlrover_tpu.checkpoint import StorageType
+
+        ckpt.save_checkpoint(step, state, StorageType.DISK)
     elapsed = time.monotonic() - t_start
     tokens = (step - start_step) * args.batch_size * args.seq_len
     logger.info(

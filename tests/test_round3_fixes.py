@@ -1,0 +1,301 @@
+"""Round-3 fix regressions: dead-node world invalidation, checkpoint
+stale-world hygiene, restore lockstep, mixed-world-size step rejection."""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.storage import CheckpointDirLayout, PosixDiskStorage
+from dlrover_tpu.master.rdzv_manager import ElasticTrainingRendezvousManager
+
+
+def _seal(manager, ranks):
+    for r in ranks:
+        manager.join_rendezvous(r, 1)
+    manager.update_rdzv_params(
+        min_nodes=len(ranks), max_nodes=len(ranks), waiting_timeout=0.1
+    )
+    round_, _, world = manager.get_comm_world(ranks[0])
+    assert set(world) == set(ranks)
+    return round_
+
+
+def test_world_changed_on_member_death():
+    m = ElasticTrainingRendezvousManager()
+    round1 = _seal(m, [0, 1])
+    assert not m.world_changed(round1)
+    # A waiting stranger does not break the sealed world...
+    m.join_rendezvous(7, 1)
+    assert not m.world_changed(round1)
+    del m._waiting_nodes[7]
+    # ...but a member death does.
+    m.remove_alive_node(1)
+    assert m.world_changed(round1)
+    # Survivor re-joins; the next sealed round clears the broken flag.
+    m.update_rdzv_params(min_nodes=1, max_nodes=2, waiting_timeout=0.0)
+    m.join_rendezvous(0, 1)
+    import time
+
+    time.sleep(0.05)
+    round2, _, world = m.get_comm_world(0)
+    assert world == {0: 1} and round2 == round1 + 1
+    assert not m.world_changed(round2)
+    # An older round is always "changed" once superseded.
+    assert m.world_changed(round1)
+
+
+def test_world_changed_ignores_non_member_death():
+    m = ElasticTrainingRendezvousManager()
+    round1 = _seal(m, [0, 1])
+    m.remove_alive_node(5)  # never part of the world
+    assert not m.world_changed(round1)
+
+
+def test_master_control_loop_recovers_dead_node_shards():
+    """Heartbeat death must evict the node from the rendezvous AND requeue
+    its in-flight data shards (the round-2 verdict's dead-end path)."""
+    from dlrover_tpu.master import messages as msg
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(num_nodes=2, min_nodes=1)
+    master.node_manager.HEARTBEAT_TIMEOUT = 0.05
+    try:
+        rdzv = master.rdzv_managers["elastic-training"]
+        round1 = _seal(rdzv, [0, 1])
+        master.task_manager.create_dataset(
+            msg.DatasetShardParams(
+                dataset_name="d", dataset_size=100, shard_size=10
+            )
+        )
+        task = master.task_manager.get_task("d", node_id=1)
+        assert not task.empty
+        master.node_manager.report_heartbeat(0, timestamp=__import__("time").time())
+        master.node_manager.report_heartbeat(1, timestamp=0.0)  # stale
+        newly_dead = master.node_manager.check_heartbeats()
+        assert newly_dead == [1]
+        master._handle_node_death(1)
+        assert rdzv.world_changed(round1)
+        # The dead node's shard is back in the queue for the survivor.
+        recovered = master.task_manager.get_task("d", node_id=0)
+        assert recovered.task_id == task.task_id
+    finally:
+        master.stop()
+
+
+def test_saver_cleans_stale_world_files(tmp_path):
+    """Re-saving a step after a world shrink must remove the old world's
+    host files; restore then accepts the new world's complete group."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    layout = CheckpointDirLayout(ckpt_dir)
+    storage = PosixDiskStorage()
+    # Old 2-host world persisted step 7 partially: host 1 died after its
+    # persist, host 0 never finished -> files host_1_of_2.* + host_1.done.
+    step_dir = layout.step_dir(7)
+    storage.safe_makedirs(step_dir)
+    storage.write(b"junk", layout.meta_path(7, 1, 2))
+    storage.write(b"junk", layout.data_path(7, 1, 2))
+    storage.write("ok:2", layout.done_path(7, 1))
+
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0)
+    saver.set_world([0])
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    state = {"w": jnp.full((2,), 3.0)}
+    engine.save_to_memory(7, state)
+    assert saver.save_step_checkpoint(7)
+
+    names = storage.listdir(step_dir)
+    assert "host_1_of_2.meta" not in names
+    assert "host_1_of_2.data" not in names
+    assert "host_1.done" not in names
+    assert layout.latest_step(storage) == 7
+    engine._shm.close(unlink=True)
+    step, loaded = engine.load_from_storage(
+        treedef=jax.tree_util.tree_structure(state)
+    )
+    assert step == 7
+    np.testing.assert_allclose(loaded["w"], [3.0, 3.0])
+    engine.close()
+    saver.stop()
+
+
+def test_stale_done_files_cannot_satisfy_commit_barrier(tmp_path):
+    """A done marker stamped by a different world size must not count."""
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    layout = CheckpointDirLayout(ckpt_dir)
+    storage = PosixDiskStorage()
+    storage.safe_makedirs(layout.step_dir(5))
+    storage.write("ok:3", layout.done_path(5, 0))  # old 3-host world stamp
+
+    saver = AsyncCheckpointSaver(
+        ckpt_dir, host_index=0, num_hosts=1, commit_timeout=0.3
+    )
+    saver.commit_checkpoint(5, expected_hosts=[0], num_hosts=1)
+    assert layout.latest_step(storage) == -1  # never committed
+    storage.write(saver._done_stamp(1), layout.done_path(5, 0))
+    saver.commit_checkpoint(5, expected_hosts=[0], num_hosts=1)
+    assert layout.latest_step(storage) == 5
+    saver.stop()
+
+
+def test_restore_rejects_ambiguous_mixed_world_step(tmp_path):
+    """Two self-consistent world-size groups in one step dir are ambiguous:
+    the step must be rejected (deterministically, not listdir-order luck)."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    old = {"w": jnp.full((2,), 1.0)}
+    engine.save_to_memory(9, old)
+    assert saver.save_step_checkpoint(9)
+    layout = CheckpointDirLayout(ckpt_dir)
+    storage = PosixDiskStorage()
+    # Forge a second complete group (world size 2) in the same step dir.
+    meta = storage.read(layout.meta_path(9, 0, 1))
+    data = storage.read(layout.data_path(9, 0, 1))
+    for host in (0, 1):
+        storage.write(meta, layout.meta_path(9, host, 2))
+        storage.write(data, layout.data_path(9, host, 2))
+    engine._shm.close(unlink=True)
+    step, loaded = engine.load_from_storage(
+        treedef=jax.tree_util.tree_structure(old)
+    )
+    assert step == -1 and loaded is None
+    engine.close()
+    saver.stop()
+
+
+def test_load_retry_stays_in_lockstep_across_hosts(tmp_path):
+    """ADVICE medium: when the newest step is corrupt on ONE host only, both
+    hosts must degrade to the older step together — the host whose local
+    attempt succeeded keeps participating in the agreement collectives."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    n = 2
+    barrier = threading.Barrier(n)
+    values = {}
+    lock = threading.Lock()
+
+    def make_agree(host):
+        calls = {"i": 0}
+
+        def agree(value):
+            idx = calls["i"]
+            calls["i"] += 1
+            with lock:
+                values.setdefault(idx, {})[host] = value
+            barrier.wait(timeout=30)
+            with lock:
+                agreed = min(values[idx].values())
+            barrier.wait(timeout=30)
+            return agreed
+
+        return agree
+
+    dirs = [str(tmp_path / f"h{i}") for i in range(n)]
+    savers = []
+    state = {"w": jnp.full((2,), 1.0)}
+    for host in range(n):
+        # Separate checkpoint dirs model per-host storage visibility (the
+        # corruption is host-local); same steps exist in both.
+        saver = AsyncCheckpointSaver(dirs[host], host_index=host, num_hosts=1)
+        saver.set_world([host])
+        writer = CheckpointEngine(
+            dirs[host], host_index=host, num_hosts=1,
+            agree_step_fn=lambda c: c,
+        )
+        for step_num, val in ((10, 1.0), (20, 2.0)):
+            writer.save_to_memory(step_num, {"w": jnp.full((2,), val)})
+            assert saver.save_step_checkpoint(step_num)
+        writer._shm.close(unlink=True)
+        savers.append(saver)
+
+    # Corrupt host 1's copy of step 20 only.
+    os.remove(CheckpointDirLayout(dirs[1]).data_path(20, 1, 1))
+
+    # Fresh engines (empty shm arenas): restore comes from storage.
+    engines = [
+        CheckpointEngine(
+            dirs[host], host_index=host, num_hosts=n,
+            agree_min_fn=make_agree(host),
+        )
+        for host in range(n)
+    ]
+    results = {}
+
+    def load(host):
+        results[host] = engines[host].load(
+            treedef=jax.tree_util.tree_structure(state)
+        )
+
+    threads = [threading.Thread(target=load, args=(h,)) for h in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "restore deadlocked across hosts"
+    for host in range(n):
+        step, loaded = results[host]
+        assert step == 10, f"host {host} restored {step}, not the agreed 10"
+        np.testing.assert_allclose(loaded["w"], [1.0, 1.0])
+    for engine, saver in zip(engines, savers):
+        engine._shm.close(unlink=True)
+        engine.close()
+        saver.stop()
+
+
+def test_make_optimizer_q8_adam_trains():
+    """Round-2 verdict: the tested q8 Adam must be reachable from
+    make_optimizer and drive a full sharded train step."""
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.models.transformer import TransformerLM
+    from dlrover_tpu.ops.quantization import Q8AdamState
+    from dlrover_tpu.parallel import rules as lr
+    from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+    from dlrover_tpu.trainer import train_lib
+
+    cfg = gpt2_config(
+        "124m", num_layers=1, d_model=64, num_heads=2,
+        vocab_size=512, max_seq_len=32,
+    )
+    model = TransformerLM(cfg)
+    mesh = build_mesh(ParallelConfig(data=-1))
+    opt = train_lib.make_optimizer("q8_adam", learning_rate=1e-2)
+    train = train_lib.build_sharded_train(
+        model, opt, mesh, lr.DEFAULT_RULES,
+        global_batch_size=8, seq_len=32,
+    )
+    state = train.init(jax.random.PRNGKey(0))
+    assert any(
+        isinstance(leaf, Q8AdamState)
+        for leaf in jax.tree.leaves(
+            state.opt_state,
+            is_leaf=lambda x: isinstance(x, Q8AdamState),
+        )
+    ), "optimizer state is not the quantized Q8AdamState"
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 512, size=(8, 33), dtype=np.int32)
+    batch = train_lib.shard_batch(
+        {"inputs": toks[:, :-1], "targets": toks[:, 1:]}, train
+    )
+    losses = []
+    for _ in range(4):
+        state, metrics = train.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,179 @@
+"""Goodput-under-chaos bench: scripted kill injection + goodput ledger.
+
+The artifact behind BASELINE.json's north-star metric (goodput >= 90% under
+injected preemption; reference method
+``docs/tech_report/fault_tolerance_exps.md:145-210``): run elastic training
+under the real master/agent stack, SIGKILL the trainer (process failure ->
+agent restart-in-place) and the whole agent group (preemption -> relaunch)
+on a schedule, and report the master SpeedMonitor's goodput ledger.
+
+    python tools/goodput_bench.py --steps 400 --kill-every 60 --out GOODPUT.json
+
+Runs on CPU (JAX_PLATFORMS=cpu) by default so it exercises the control
+plane, not the chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _children(pid: int):
+    try:
+        with open(f"/proc/{pid}/task/{pid}/children") as f:
+            return [int(p) for p in f.read().split()]
+    except OSError:
+        return []
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=600)
+    ap.add_argument("--step-sleep", type=float, default=1.0,
+                    help="per-step sleep standing in for real step compute "
+                         "(a 1.5B TPU step is ~2s; the toy CPU step is ~ms)")
+    ap.add_argument("--kill-every", type=float, default=150.0,
+                    help="seconds between injected failures (TPU-VM spot "
+                         "preemptions are minutes-to-hours apart; 150s is "
+                         "far harsher than the north-star scenario)")
+    ap.add_argument("--reprovision-delay", type=float, default=3.0,
+                    help="simulated node re-provisioning time after a "
+                         "group kill")
+    ap.add_argument("--workdir", default="/tmp/dlrover_tpu_goodput")
+    ap.add_argument("--out", default="GOODPUT.json")
+    ap.add_argument("--target", type=float, default=0.9)
+    args = ap.parse_args()
+
+    from dlrover_tpu.master.job_master import JobMaster
+
+    os.makedirs(args.workdir, exist_ok=True)
+    ckpt = os.path.join(args.workdir, "ckpt")
+    # Injected failures are the point of this bench: the relaunch/restart
+    # budget must never be the thing that ends the run.
+    # heartbeat-interval 2s below: 8s = four missed beats, the detection
+    # latency a silent SIGKILL pays (SIGTERM preemptions report instantly).
+    master = JobMaster(
+        num_nodes=1, heartbeat_timeout=8.0, max_relaunches=10**6
+    )
+    master.CONTROL_LOOP_INTERVAL = 2.0
+    port = master.start()
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DLROVER_TPU_SOCKET_DIR": os.path.join(args.workdir, "socks"),
+        "DLROVER_TPU_JOB": f"goodput{os.getpid()}",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # Restarted trainers hit the persistent compile cache instead of
+        # re-tracing — the same lever that keeps real-TPU restarts fast
+        # (SURVEY.md §7 hard part #1: compile cache for elastic resizing).
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(args.workdir, "jaxcache"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.1",
+    })
+    env.pop("XLA_FLAGS", None)
+
+    def spawn_agent():
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--master", f"localhost:{port}",
+            "--nnodes", "1", "--node-id", "0",
+            "--max-restarts", "1000",
+            "--monitor-interval", "0.5",
+            "--heartbeat-interval", "2",
+            "--save-at-breakpoint",
+            "--checkpoint-dir", ckpt,
+            "--", sys.executable, os.path.join(REPO, "examples", "train_lm.py"),
+            "--steps", str(args.steps), "--ckpt-every", "10",
+            "--checkpoint-dir", ckpt,
+            "--layers", "1", "--d-model", "64", "--heads", "2",
+            "--seq-len", "64", "--batch-size", "4",
+            "--step-sleep", str(args.step_sleep),
+        ]
+        return subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    t_start = time.monotonic()
+    agent = spawn_agent()
+    kills = []
+    next_kill = time.monotonic() + args.kill_every
+    mode = 0
+    while True:
+        rc = agent.poll()
+        if rc is not None:
+            if rc == 0:
+                break
+            # Agent died from a group kill: reprovision after a delay.
+            time.sleep(args.reprovision_delay)
+            agent = spawn_agent()
+            continue
+        now = time.monotonic()
+        if now >= next_kill and master.speed_monitor.global_step < args.steps - 20:
+            next_kill = now + args.kill_every
+            if mode == 0:
+                # Process failure: kill the trainer only.
+                trainers = [
+                    c for c in _children(agent.pid)
+                ]
+                for pid in trainers:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        continue
+                kills.append({"t": round(now - t_start, 1),
+                              "kind": "trainer_sigkill"})
+                print(f"[chaos] killed trainer(s) {trainers}", flush=True)
+            else:
+                # Preemption: kill the whole node group; harness relaunches.
+                try:
+                    os.killpg(os.getpgid(agent.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                kills.append({"t": round(now - t_start, 1),
+                              "kind": "node_preemption"})
+                print("[chaos] preempted node group", flush=True)
+            mode ^= 1
+        if now - t_start > args.steps * args.step_sleep * 6 + 600:
+            print("goodput bench timed out", file=sys.stderr)
+            break
+        time.sleep(1.0)
+
+    sm = master.speed_monitor
+    total_s = time.monotonic() - t_start
+    productive = sm._productive_s
+    first = sm._first_step_time
+    training_s = (time.time() - first) if first else total_s
+    result = {
+        "metric": "goodput under injected failures",
+        "value": round(sm.goodput(), 4),
+        "unit": "fraction",
+        "vs_baseline": round(sm.goodput() / args.target, 4),
+        "detail": {
+            "goodput_total": round(sm.goodput(), 4),
+            "goodput_training_phase": round(
+                min(1.0, productive / training_s) if training_s > 0 else 0.0, 4
+            ),
+            "productive_s": round(productive, 1),
+            "wall_s": round(total_s, 1),
+            "final_step": sm.global_step,
+            "target_steps": args.steps,
+            "kills": kills,
+            "completed": sm.global_step >= args.steps,
+        },
+    }
+    master.stop()
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0 if result["detail"]["completed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

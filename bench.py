@@ -1,11 +1,13 @@
 """Benchmark: GPT-2 1.5B training throughput, tokens/sec/chip (BASELINE.json).
 
 Runs the sharded train step on the attached TPU chip(s) and prints ONE JSON
-line.  ``vs_baseline`` compares against the analogy target derived from the
-reference's best published utilization (Llama2-7B FSDP at 65.6% HFU on A100,
-`BASELINE.md`): the same 65.6% of this chip's peak bf16 FLOPs spent on GPT-2
-1.5B (6*N FLOPs/token + attention) — i.e. vs_baseline > 1 means we beat the
-reference's utilization on our hardware.
+line.  ``vs_baseline`` compares hardware FLOPs utilization (HFU) against the
+reference's best published HFU (Llama2-7B FSDP at 65.6% on A100,
+`BASELINE.md` — the reference trains with activation checkpointing, so its
+65.6% *includes* recompute FLOPs).  Comparing HFU to HFU is the
+apples-to-apples form; the model-FLOPs view (MFU, recompute not counted) is
+reported alongside in ``detail`` with its own ``vs_baseline_mfu``.
+See PROFILE.md for the measured step breakdown behind the chosen config.
 """
 
 from __future__ import annotations
@@ -19,10 +21,12 @@ import numpy as np
 
 MODEL_SIZE = "1.5b"
 SEQ_LEN = 1024
-PER_CHIP_BATCH = 16
+PER_CHIP_BATCH = 16     # measured fastest (24/32 spill or OOM, 8 underfills)
+REMAT = "attn_out"      # measured fastest policy that fits (PROFILE.md)
+CE_CHUNKS = 16          # never materializes the [B,S,V] fp32 logits
 WARMUP_STEPS = 2
 MEASURE_STEPS = 10
-REFERENCE_HFU = 0.656  # Llama2-7B FSDP, BASELINE.md best utilization claim
+REFERENCE_HFU = 0.656   # Llama2-7B FSDP, BASELINE.md best utilization claim
 
 _PEAK_BF16_TFLOPS = {
     "tpu v5 lite": 197.0,   # v5e
@@ -42,10 +46,37 @@ def chip_peak_tflops() -> float:
 
 
 def flops_per_token(config) -> float:
-    """6*N matmul FLOPs/token plus attention score/value FLOPs."""
+    """Model FLOPs/token: 6*N matmul plus attention score/value FLOPs."""
     n = config.num_params()
     attn = 12 * config.num_layers * config.d_model * SEQ_LEN  # fwd+bwd qk+av
     return 6 * n + attn
+
+
+def recompute_flops_per_token(config, remat: str) -> float:
+    """Extra hardware FLOPs/token the backward re-executes under ``remat``.
+
+    attn_out saves the post-projection attention output, so the backward
+    re-runs per layer: the fused QKV projection, both MLP matmuls, and the
+    attention forward (the out-projection forward is skipped).  This is what
+    HFU counts on top of model FLOPs — the same accounting the reference's
+    65.6% HFU uses for its activation-checkpointed runs.
+    """
+    if remat == "none":
+        return 0.0
+    d = config.d_model
+    hd = config.resolved_head_dim * config.num_heads
+    ff = config.resolved_d_ff
+    qkv = 2 * d * 3 * hd
+    mlp = 2 * d * ff * 2
+    attn_fwd = 4 * d * SEQ_LEN
+    out_proj = 2 * hd * d
+    per_layer = {
+        "full": qkv + mlp + attn_fwd + out_proj,
+        "attn_out": qkv + mlp + attn_fwd,
+        "branch_out": qkv + mlp + attn_fwd,
+        "dots": attn_fwd,
+    }.get(remat, qkv + mlp + attn_fwd)
+    return per_layer * config.num_layers
 
 
 def main() -> None:
@@ -60,7 +91,7 @@ def main() -> None:
         MODEL_SIZE,
         max_seq_len=SEQ_LEN,
         param_dtype=jnp.bfloat16,
-        remat="full",
+        remat=REMAT,
         attention_impl="flash",
     )
     model = TransformerLM(config)
@@ -73,6 +104,7 @@ def main() -> None:
     train = train_lib.build_sharded_train(
         model, opt, mesh, lr.DEFAULT_RULES,
         global_batch_size=global_batch, seq_len=SEQ_LEN,
+        ce_chunks=CE_CHUNKS,
     )
     state = train.init(jax.random.PRNGKey(0))
 
@@ -102,23 +134,36 @@ def main() -> None:
     tokens_per_sec_chip = tokens_per_sec / n_chips
 
     ftok = flops_per_token(config)
-    achieved_tflops = tokens_per_sec_chip * ftok / 1e12
+    ftok_hw = ftok + recompute_flops_per_token(config, REMAT)
     peak = chip_peak_tflops()
-    mfu = achieved_tflops / peak
+    mfu = tokens_per_sec_chip * ftok / 1e12 / peak
+    hfu = tokens_per_sec_chip * ftok_hw / 1e12 / peak
     baseline_tokens_per_sec_chip = REFERENCE_HFU * peak * 1e12 / ftok
 
     print(json.dumps({
         "metric": "gpt2-1.5b tokens/sec/chip",
         "value": round(tokens_per_sec_chip, 2),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(tokens_per_sec_chip / baseline_tokens_per_sec_chip, 4),
+        "vs_baseline": round(hfu / REFERENCE_HFU, 4),
         "detail": {
             "n_chips": n_chips,
             "global_batch": global_batch,
             "seq_len": SEQ_LEN,
+            "remat": REMAT,
             "step_time_s": round(dt / MEASURE_STEPS, 4),
-            "achieved_tflops_per_chip": round(achieved_tflops, 2),
+            "achieved_model_tflops_per_chip": round(
+                tokens_per_sec_chip * ftok / 1e12, 2
+            ),
+            "achieved_hw_tflops_per_chip": round(
+                tokens_per_sec_chip * ftok_hw / 1e12, 2
+            ),
             "mfu": round(mfu, 4),
+            "hfu": round(hfu, 4),
+            "vs_baseline_basis": "hfu / reference_hfu (both count "
+                                 "activation-recompute FLOPs)",
+            "vs_baseline_mfu": round(
+                tokens_per_sec_chip / baseline_tokens_per_sec_chip, 4
+            ),
             "loss": final_loss,
         },
     }))

@@ -21,16 +21,74 @@ TPU-first design notes:
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models import layers
 from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+    mesh_axis_size,
+)
 
 NEG_INF = -1e15
+
+
+def ulysses_attention(
+    attn_fn: Callable,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: Optional[jax.Array],
+) -> jax.Array:
+    """Run ``attn_fn`` under explicit Ulysses all-to-alls over the seq axis.
+
+    Counterpart of the reference's ``_SeqAllToAll`` autograd function
+    (ref ``atorch/atorch/distributed/distributed.py:474-501``).  Inputs
+    arrive sequence-sharded ``[B, S/sp, H, D]``; inside the shard_map an
+    ``all_to_all`` swaps the shards to head-sharded ``[B, S, H/sp, D]``
+    for the attention math, and back after.
+
+    Expressing the switch as annotations alone (``ACT_HEADS ->
+    (seq, tensor)`` constraints) leaves the resharding decision to the
+    SPMD partitioner, which falls back to "involuntary full
+    rematerialization" (replicate + repartition) on the boundary reshapes
+    — the explicit collective compiles to a clean ICI all-to-all instead.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    batch_spec = (DATA_AXIS, FSDP_AXIS)
+    io_spec = P(batch_spec, SEQ_AXIS, TENSOR_AXIS, None)
+    specs = [io_spec, io_spec, io_spec]
+    args = [q, k, v]
+    if segment_ids is not None:
+        specs.append(P(batch_spec, None))
+        args.append(segment_ids)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=tuple(specs),
+        out_specs=io_spec,
+        check_vma=False,
+    )
+    def inner(q, k, v, seg=None):
+        swap = functools.partial(
+            jax.lax.all_to_all, axis_name=SEQ_AXIS,
+            split_axis=2, concat_axis=1, tiled=True,
+        )
+        out = attn_fn(swap(q), swap(k), swap(v), seg)
+        return jax.lax.all_to_all(
+            out, axis_name=SEQ_AXIS, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    return inner(*args)
 
 
 def xla_attention(
@@ -158,33 +216,38 @@ class Attention(nn.Module):
             out = ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
             out = nn.with_logical_constraint(out, spec)
         else:
-            # Ulysses boundary: reshard seq-split -> head-split (a2a under SP).
-            attn_spec = (lr.BATCH, None, lr.ACT_HEADS, lr.KV)
-            q = nn.with_logical_constraint(q, attn_spec)
-            k = nn.with_logical_constraint(k, attn_spec)
-            v = nn.with_logical_constraint(v, attn_spec)
-
             if self.attention_impl == "flash":
                 from dlrover_tpu.ops import flash_attention as fa
 
-                out = fa.mha(
-                    q, k, v,
-                    causal=True,
-                    segment_ids=segment_ids,
-                    block_q=self.flash_block_q,
-                    block_kv=self.flash_block_kv,
-                )
+                def attn_fn(q, k, v, seg):
+                    return fa.mha(
+                        q, k, v,
+                        causal=True,
+                        segment_ids=seg,
+                        block_q=self.flash_block_q,
+                        block_kv=self.flash_block_kv,
+                    )
             elif self.attention_impl == "xla":
-                out = xla_attention(
-                    q, k, v, causal=True, segment_ids=segment_ids
-                )
+                def attn_fn(q, k, v, seg):
+                    return xla_attention(
+                        q, k, v, causal=True, segment_ids=seg
+                    )
             else:
                 raise ValueError(
                     f"unknown attention_impl {self.attention_impl!r}"
                 )
 
-            # Ulysses boundary back: head-split -> seq-split.
-            out = nn.with_logical_constraint(out, attn_spec)
+            if mesh_axis_size(SEQ_AXIS) > 1:
+                # Ulysses SP: explicit seq<->heads all-to-alls (see
+                # ulysses_attention docstring for why not annotations).
+                out = ulysses_attention(attn_fn, q, k, v, segment_ids)
+            else:
+                attn_spec = (lr.BATCH, None, lr.ACT_HEADS, lr.KV)
+                q = nn.with_logical_constraint(q, attn_spec)
+                k = nn.with_logical_constraint(k, attn_spec)
+                v = nn.with_logical_constraint(v, attn_spec)
+                out = attn_fn(q, k, v, segment_ids)
+                out = nn.with_logical_constraint(out, attn_spec)
         out = layers.DenseGeneral(
             features,
             axis=(-2, -1),

@@ -111,10 +111,19 @@ class Embed(nn.Module):
             (self.num_embeddings, self.features),
             self.param_dtype,
         )
-        # plain gather: XLA lowers this to a sharded gather (+psum) when the
-        # table carries a vocab split.
-        out = embedding.astype(self.dtype)[ids]
-        return out
+        # Gather from a table whose embed dim is force-unsharded: under FSDP
+        # the storage stays sharded but the lookup runs on an explicitly
+        # all-gathered copy (standard FSDP compute semantics).  Without this
+        # the partitioner cannot reconcile an fsdp-sharded table dim with an
+        # fsdp-sharded batch dim in the gather output and falls back to
+        # "involuntary full rematerialization" (replicate + repartition).
+        # The vocab split (tensor) stays on the table: XLA lowers that to a
+        # masked local gather + psum.
+        table = nn.with_logical_constraint(
+            embedding.astype(self.dtype),
+            (lax_rules.VOCAB, lax_rules.GATHERED),
+        )
+        return table[ids]
 
     def attend(self, x: jax.Array) -> jax.Array:
         """Project hidden states onto the (tied) embedding table -> logits."""

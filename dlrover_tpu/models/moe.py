@@ -25,6 +25,23 @@ from dlrover_tpu.models import layers
 from dlrover_tpu.parallel import rules as lr
 
 
+def _gate(logits: jax.Array, k: int):
+    """Shared top-k gate: (gate_vals, gate_idx, aux_loss)."""
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)            # [B,S,k]
+    # renormalize the chosen gates
+    gate_vals = gate_vals / jnp.clip(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+    # Load-balancing aux loss: mean prob * mean assignment per expert.
+    top1_onehot = jax.nn.one_hot(gate_idx[..., 0], e, dtype=jnp.float32)
+    density = jnp.mean(top1_onehot, axis=(0, 1))             # [E]
+    density_proxy = jnp.mean(probs, axis=(0, 1))             # [E]
+    aux_loss = jnp.sum(density * density_proxy) * (e ** 2) / k
+    return gate_vals, gate_idx, aux_loss
+
+
 def top_k_gating(
     logits: jax.Array, k: int, capacity: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -36,18 +53,7 @@ def top_k_gating(
     auxiliary loss (ref ``topk_gating.py`` capability).
     """
     b, s, e = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate_vals, gate_idx = jax.lax.top_k(probs, k)            # [B,S,k]
-    # renormalize the chosen gates
-    gate_vals = gate_vals / jnp.clip(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
-    )
-
-    # Load-balancing aux loss: mean prob * mean assignment per expert.
-    top1_onehot = jax.nn.one_hot(gate_idx[..., 0], e, dtype=jnp.float32)
-    density = jnp.mean(top1_onehot, axis=(0, 1))             # [E]
-    density_proxy = jnp.mean(probs, axis=(0, 1))             # [E]
-    aux_loss = jnp.sum(density * density_proxy) * (e ** 2) / k
+    gate_vals, gate_idx, aux_loss = _gate(logits, k)
 
     # Assign capacity slots expert-by-expert in token order.  Slots taken by
     # earlier choice ranks offset later ranks (`prior`), so a token picked
@@ -73,7 +79,22 @@ def top_k_gating(
 
 
 class MoEMlp(nn.Module):
-    """Expert-parallel MLP with top-k routing and capacity-based dispatch."""
+    """Expert-parallel MLP with top-k routing.
+
+    Two dispatch paths:
+
+    * ``"einsum"`` — classic dense capacity dispatch (Shazeer/mesh-TF
+      lineage): static [B, S, E, C] tensors whose expert dim shards over the
+      ``expert`` mesh axis, GSPMD inserting the a2a.  Tokens beyond an
+      expert's capacity are dropped; capacity padding burns FLOPs.
+    * ``"grouped"`` — dropless megablocks-style dispatch through the Pallas
+      grouped-matmul kernel (ref
+      ``atorch/atorch/modules/moe/grouped_gemm_moe.py:46``): token-choices
+      are sorted by expert and each expert's ragged row group runs as one
+      grouped GEMM — no token drops, padding bounded by E x block rows
+      instead of the capacity factor.  Used when the expert mesh axis is 1
+      (kernels are per-device; under EP>1 the einsum path carries the a2a).
+    """
 
     num_experts: int
     d_ff: int
@@ -82,12 +103,13 @@ class MoEMlp(nn.Module):
     activation: str = "swiglu"
     dtype: layers.Dtype = jnp.bfloat16
     param_dtype: layers.Dtype = jnp.float32
+    dispatch: str = "einsum"        # "einsum" | "grouped"
+    gmm_block_rows: int = 128
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         b, s, d = x.shape
         e = self.num_experts
-        capacity = max(1, int(self.capacity_factor * s * self.top_k / e))
 
         router_logits = layers.DenseGeneral(
             e,
@@ -96,18 +118,6 @@ class MoEMlp(nn.Module):
             param_dtype=self.param_dtype,
             name="router",
         )(x.astype(jnp.float32))
-        dispatch, combine, aux_loss = top_k_gating(
-            router_logits, self.top_k, capacity
-        )
-        dispatch = dispatch.astype(self.dtype)
-        combine = combine.astype(self.dtype)
-
-        # Token shuffle: expert dim sharded over the `expert` mesh axis —
-        # this einsum IS the all-to-all under EP.
-        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(self.dtype))
-        expert_in = nn.with_logical_constraint(
-            expert_in, (lr.EXPERT, lr.BATCH, None, lr.ACT_EMBED)
-        )
 
         wi_shape = (e, d, self.d_ff)
         wi_axes = (lr.EXPERT, lr.EMBED, lr.MLP)
@@ -125,7 +135,7 @@ class MoEMlp(nn.Module):
             wi_shape,
             self.param_dtype,
         ).astype(self.dtype)
-        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi)
+        wg = None
         if self.activation == "swiglu":
             wg = self.param(
                 "wg",
@@ -133,6 +143,33 @@ class MoEMlp(nn.Module):
                 wi_shape,
                 self.param_dtype,
             ).astype(self.dtype)
+
+        from dlrover_tpu.runtime.mesh import EXPERT_AXIS, mesh_axis_size
+
+        if self.dispatch == "grouped" and mesh_axis_size(EXPERT_AXIS) == 1:
+            return self._grouped_forward(x, router_logits, wi, wg, wo)
+        return self._einsum_forward(x, router_logits, wi, wg, wo)
+
+    # -- capacity einsum dispatch (EP-shardable) ------------------------------
+
+    def _einsum_forward(self, x, router_logits, wi, wg, wo):
+        b, s, d = x.shape
+        e = self.num_experts
+        capacity = max(1, int(self.capacity_factor * s * self.top_k / e))
+        dispatch, combine, aux_loss = top_k_gating(
+            router_logits, self.top_k, capacity
+        )
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
+
+        # Token shuffle: expert dim sharded over the `expert` mesh axis —
+        # this einsum IS the all-to-all under EP.
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(self.dtype))
+        expert_in = nn.with_logical_constraint(
+            expert_in, (lr.EXPERT, lr.BATCH, None, lr.ACT_EMBED)
+        )
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi)
+        if wg is not None:
             g = jnp.einsum("ebcd,edf->ebcf", expert_in, wg)
             h = nn.silu(g) * h
         else:
@@ -145,3 +182,49 @@ class MoEMlp(nn.Module):
         # Un-shuffle (second a2a) + weighted combine.
         out = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
         return out, aux_loss.astype(jnp.float32)
+
+    # -- dropless grouped-GEMM dispatch ---------------------------------------
+
+    def _grouped_forward(self, x, router_logits, wi, wg, wo):
+        from dlrover_tpu.ops.grouped_matmul import grouped_matmul
+
+        b, s, d = x.shape
+        e, k = self.num_experts, self.top_k
+        block = self.gmm_block_rows
+        n = b * s * k
+        # Static row budget: every token-choice plus at most one partial
+        # block of padding per expert, rounded to whole kernel blocks.
+        n_pad = ((n + block - 1) // block + e) * block
+
+        x_flat = x.reshape(b * s, d).astype(self.dtype)
+        gate_vals, gate_idx, aux_loss = _gate(router_logits, k)
+        experts_flat = gate_idx.reshape(n)                   # [N]
+        gates_flat = gate_vals.reshape(n).astype(self.dtype)
+        token_of_choice = jnp.arange(n, dtype=jnp.int32) // k
+
+        # Stable sort by expert: each expert's choices become one
+        # consecutive ragged group.
+        order = jnp.argsort(experts_flat, stable=True)
+        expert_sorted = experts_flat[order]
+        src_token = token_of_choice[order]
+        counts = jnp.zeros((e,), jnp.int32).at[experts_flat].add(1)
+        padded = ((counts + block - 1) // block) * block     # group sizes
+        group_starts = jnp.cumsum(padded) - padded
+        count_starts = jnp.cumsum(counts) - counts
+        rank = jnp.arange(n, dtype=jnp.int32) - count_starts[expert_sorted]
+        dest = group_starts[expert_sorted] + rank            # [N] row slots
+
+        rows = jnp.zeros((n_pad, d), self.dtype).at[dest].set(
+            x_flat[src_token]
+        )
+        h = grouped_matmul(rows, wi, padded, block)
+        if wg is not None:
+            g = grouped_matmul(rows, wg, padded, block)
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h)
+        out_rows = grouped_matmul(h, wo, padded, block)
+
+        weighted = out_rows[dest] * gates_flat[order][:, None]
+        out = jnp.zeros((b * s, d), self.dtype).at[src_token].add(weighted)
+        return out.reshape(b, s, d), aux_loss.astype(jnp.float32)

@@ -53,15 +53,20 @@ class TransformerConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    moe_dispatch: str = "einsum"   # "einsum" (EP-shardable) | "grouped"
     # numerics / execution
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"    # "xla" | "flash"
-    fused_qkv: bool = True         # one [d,H,3*hd] matmul when no GQA
+    # One [d,H,3*hd] matmul when no GQA.  NOTE: flips the attention param
+    # tree from query/key/value to qkv — a checkpoint format change;
+    # set False to restore pre-round-3 checkpoints.
+    fused_qkv: bool = True
     flash_block_q: int = 1024      # measured fastest on v5e at seq 1024
     flash_block_kv: int = 1024
     remat: str = "none"            # "none" | "dots" | "full"
     scan_layers: bool = True
+    scan_unroll: int = 1           # layers per scan iteration (XLA overlap)
     logits_dtype: Any = jnp.float32
     # Pipeline parallelism (see parallel/pipeline.py): stages must divide
     # num_layers; microbatches default to the stage count.
@@ -200,6 +205,7 @@ class Block(nn.Module):
                 activation=cfg.activation,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
+                dispatch=cfg.moe_dispatch,
                 name="moe",
             )(y)
             aux = aux + layer_aux
@@ -212,6 +218,10 @@ class Block(nn.Module):
                 param_dtype=cfg.param_dtype,
                 name="mlp",
             )(y)
+        # Under the "branch_out" policy the backward rebuilds the residual
+        # stream from saved branch outputs instead of re-running the wo
+        # matmul (b*s*d bf16 per layer of extra HBM each).
+        y = jax.ad_checkpoint.checkpoint_name(y, "mlp_out")
         x = x + y
         x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
         return (x, aux), None
@@ -226,6 +236,11 @@ _REMAT_POLICIES = {
     # save only the attention block output (cheap in HBM, skips the most
     # expensive recompute); everything else rematerializes
     "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
+    # save both residual-branch outputs: backward skips the attention AND
+    # the wo-matmul recompute for reconstructing the residual stream
+    "branch_out": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "mlp_out"
+    ),
 }
 
 
@@ -295,6 +310,7 @@ class TransformerLM(nn.Module):
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,
+                unroll=cfg.scan_unroll,
                 metadata_params={nn.PARTITION_NAME: lr.LAYERS},
             )(cfg, name="blocks")
             (x, aux), _ = stack((x, aux0), positions, segment_ids)

@@ -38,9 +38,10 @@ def _gmm_kernel(expert_of_block, x_ref, w_ref, out_ref):
 def _expert_of_block(group_sizes, num_blocks, block_rows):
     offsets = jnp.cumsum(group_sizes)
     block_starts = jnp.arange(num_blocks, dtype=jnp.int32) * block_rows
-    return jnp.searchsorted(offsets, block_starts, side="right").astype(
-        jnp.int32
-    )
+    eob = jnp.searchsorted(offsets, block_starts, side="right")
+    # Rows past sum(group_sizes) (caller's static padding budget) clamp to
+    # the last expert: they hold zeros, so the extra GEMM work is inert.
+    return jnp.minimum(eob, group_sizes.shape[0] - 1).astype(jnp.int32)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

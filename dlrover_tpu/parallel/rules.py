@@ -54,6 +54,7 @@ EXPERT = "expert"          # param expert dim (EP shard dim)
 LAYERS = "layers"          # scanned layer dim (within one pipeline stage)
 STAGES = "stages"          # pipeline stage dim (params + rolling state buffer)
 NORM = "norm"              # 1-D norm scales/biases
+GATHERED = "gathered"      # force-unsharded dim (explicit FSDP all-gather)
 
 
 def make_rules(
@@ -82,6 +83,7 @@ def make_rules(
         (ACT_EMBED, TENSOR_AXIS),
         (KV, None),
         (NORM, None),
+        (GATHERED, None),
     ]
     rules.append((ACT_SEQ, SEQ_AXIS if sequence else None))
     if context == "ring":

@@ -1,0 +1,83 @@
+"""Dropless grouped-GEMM MoE dispatch: parity with the capacity einsum path
+and the no-drop guarantee (round-2 verdict: wire grouped_matmul into MoEMlp).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.moe import MoEMlp
+
+
+def _run(dispatch, x, capacity_factor=8.0, seed=0):
+    layer = MoEMlp(
+        num_experts=4,
+        d_ff=64,
+        top_k=2,
+        capacity_factor=capacity_factor,
+        activation="gelu",
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        dispatch=dispatch,
+        gmm_block_rows=8,
+    )
+    params = layer.init(jax.random.PRNGKey(seed), x)
+    out, aux = layer.apply(params, x)
+    return np.asarray(out), float(aux), params
+
+
+def test_grouped_matches_einsum_when_capacity_ample():
+    """With capacity large enough that the einsum path drops nothing, both
+    dispatch implementations compute the same function."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32)
+    layer_kw = dict(seed=0)
+    out_e, aux_e, params = _run("einsum", x, **layer_kw)
+    # Same params: re-apply with grouped dispatch.
+    layer_g = MoEMlp(
+        num_experts=4, d_ff=64, top_k=2, capacity_factor=8.0,
+        activation="gelu", dtype=jnp.float32, param_dtype=jnp.float32,
+        dispatch="grouped", gmm_block_rows=8,
+    )
+    out_g, aux_g = layer_g.apply(params, x)
+    np.testing.assert_allclose(out_e, np.asarray(out_g), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(aux_e, float(aux_g), rtol=1e-5)
+
+
+def test_grouped_is_dropless_under_tight_capacity():
+    """capacity_factor only affects the einsum path: grouped keeps every
+    token-choice even when the einsum path would drop most of them."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32)), jnp.float32)
+    out_ample, _, params = _run("einsum", x, capacity_factor=8.0, seed=1)
+    layer_g = MoEMlp(
+        num_experts=4, d_ff=64, top_k=2, capacity_factor=0.25,
+        activation="gelu", dtype=jnp.float32, param_dtype=jnp.float32,
+        dispatch="grouped", gmm_block_rows=8,
+    )
+    out_g, _ = layer_g.apply(params, x)
+    # Grouped output equals the no-drop function regardless of capacity.
+    np.testing.assert_allclose(
+        out_ample, np.asarray(out_g), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_grouped_gradients_flow():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32)
+    layer = MoEMlp(
+        num_experts=4, d_ff=64, top_k=2, activation="swiglu",
+        dtype=jnp.float32, param_dtype=jnp.float32,
+        dispatch="grouped", gmm_block_rows=8,
+    )
+    params = layer.init(jax.random.PRNGKey(2), x)
+
+    def loss_fn(p):
+        out, aux = layer.apply(p, x)
+        return jnp.sum(out ** 2) + aux
+
+    grads = jax.grad(loss_fn)(params)
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(norms))
+    assert any(n > 0 for n in norms), "no gradient reached the experts"

@@ -1,0 +1,89 @@
+"""Ulysses SP lowering: the seq<->heads switch must compile to a clean ICI
+all-to-all, never a replicate-then-repartition of full activations (the
+round-2 verdict's "involuntary full rematerialization" finding)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.transformer import TransformerLM
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.trainer import train_lib
+
+BATCH, SEQ = 8, 32
+
+
+def _compiled_step_text(parallel: ParallelConfig) -> str:
+    config = gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=4,
+        vocab_size=512, max_seq_len=SEQ,
+    )
+    model = TransformerLM(config)
+    mesh = build_mesh(parallel)
+    opt = train_lib.make_optimizer("adamw", learning_rate=1e-3)
+    train = train_lib.build_sharded_train(
+        model, opt, mesh, lr.DEFAULT_RULES,
+        global_batch_size=BATCH, seq_len=SEQ,
+    )
+    state_shape = jax.eval_shape(train.init_fn, jax.random.PRNGKey(0))
+    batch_shape = {
+        k: jax.ShapeDtypeStruct(
+            (BATCH, SEQ), jnp.float32 if k == "weights" else jnp.int32
+        )
+        for k in ("inputs", "targets", "weights")
+    }
+    with train_lib.use_mesh(mesh):
+        return train.step_fn.lower(state_shape, batch_shape).compile().as_text()
+
+
+@pytest.mark.slow
+def test_sp_step_lowers_to_all_to_all_without_full_gather():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    txt = _compiled_step_text(ParallelConfig(data=2, seq=2, tensor=2))
+    assert "all-to-all" in txt, "Ulysses boundary did not lower to a2a"
+    # The failure mode being guarded: replicating the full [B,S,H,D]
+    # activation (all-gather to unsharded) at the attention boundary.
+    full_qkv = rf"all-gather[^=]*=\s*bf16\[{BATCH},{SEQ},4,16\]"
+    assert not re.search(full_qkv, txt), (
+        "attention boundary all-gathers the full activation (involuntary "
+        "rematerialization)"
+    )
+
+
+@pytest.mark.slow
+def test_sp_matches_dp_numerically():
+    """The explicit a2a path must compute the same step as plain DP."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    config = gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=4,
+        vocab_size=512, max_seq_len=SEQ,
+    )
+    model = TransformerLM(config)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 512, size=(BATCH, SEQ + 1), dtype=np.int32)
+    losses = {}
+    for name, parallel in {
+        "dp": ParallelConfig(data=-1),
+        "sp_tp": ParallelConfig(data=2, seq=2, tensor=2),
+    }.items():
+        mesh = build_mesh(parallel)
+        opt = train_lib.make_optimizer("adamw", learning_rate=1e-3)
+        train = train_lib.build_sharded_train(
+            model, opt, mesh, lr.DEFAULT_RULES,
+            global_batch_size=BATCH, seq_len=SEQ,
+        )
+        state = train.init(jax.random.PRNGKey(0))
+        batch = train_lib.shard_batch(
+            {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}, train
+        )
+        for _ in range(2):
+            state, metrics = train.step(state, batch)
+        losses[name] = float(metrics["loss"])
+    np.testing.assert_allclose(losses["dp"], losses["sp_tp"], rtol=2e-2)

@@ -23,7 +23,9 @@ REFERENCE_HFU = 0.656
 
 
 def run(remat: str, batch: int, steps: int, opt_name: str, trace: str | None,
-        attention_impl: str = "flash", ce_chunks: int = 0) -> None:
+        attention_impl: str = "flash", ce_chunks: int = 0,
+        block_q: int = 1024, block_kv: int = 1024,
+        scan_unroll: int = 1) -> None:
     from dlrover_tpu.models.gpt2 import gpt2_config
     from dlrover_tpu.models.transformer import TransformerLM
     from dlrover_tpu.parallel import rules as lr
@@ -34,6 +36,8 @@ def run(remat: str, batch: int, steps: int, opt_name: str, trace: str | None,
     config = gpt2_config(
         "1.5b", max_seq_len=SEQ_LEN, param_dtype=jnp.bfloat16,
         remat=remat, attention_impl=attention_impl,
+        flash_block_q=block_q, flash_block_kv=block_kv,
+        scan_unroll=scan_unroll,
     )
     model = TransformerLM(config)
     mesh = build_mesh(ParallelConfig(data=-1, fsdp=1))
@@ -74,6 +78,7 @@ def run(remat: str, batch: int, steps: int, opt_name: str, trace: str | None,
     mem = jax.devices()[0].memory_stats() or {}
     print(json.dumps({
         "remat": remat, "batch": batch, "opt": opt_name, "ce": ce_chunks,
+        "blocks": [block_q, block_kv],
         "step_s": round(dt, 4), "tok_s_chip": round(tok_s, 1),
         "mfu": round(mfu, 4), "vs_baseline": round(tok_s / base, 4),
         "peak_hbm_gb": round(mem.get("peak_bytes_in_use", 0) / 2**30, 2),
@@ -90,4 +95,7 @@ if __name__ == "__main__":
         trace=kv.get("trace"),
         attention_impl=kv.get("attn", "flash"),
         ce_chunks=int(kv.get("ce", 0)),
+        block_q=int(kv.get("bq", 1024)),
+        block_kv=int(kv.get("bkv", 1024)),
+        scan_unroll=int(kv.get("unroll", 1)),
     )

@@ -1,0 +1,1 @@
+from dlrover_tpu.auto.tune import TuneResult, auto_tune  # noqa: F401

@@ -1,0 +1,410 @@
+"""auto_tune: single-call strategy search — the auto_accelerate equivalent.
+
+Capability ref: ``atorch/atorch/auto/accelerate.py:406-653`` (single call
+finds + applies the best strategy), engine
+``atorch/atorch/auto/engine/acceleration_engine.py:13-94`` (ANALYSE / TUNE /
+DRYRUN task loop) and the BO searcher
+``atorch/atorch/auto/engine/sg_algo/bayes_opt_sg.py``.
+
+TPU redesign of the search: the reference must dry-run candidate strategies
+because a CUDA strategy's cost is opaque until executed; under XLA the
+strategy space is small and analytic — a strategy here is just
+(mesh factorization x remat policy), everything else being sharding rules
+that compose freely.  So instead of a Bayesian optimizer over measured
+dry-runs we:
+
+1. ANALYSE  — enumerate the legal mesh factorizations (divisibility of
+   heads/seq/experts/layers) and remat policies;
+2. PRUNE    — reject candidates whose static per-device memory estimate
+   (params + grads + optimizer + activations by remat policy) exceeds the
+   HBM budget, and rank the survivors with an analytic step-time model
+   (MXU FLOPs + HBM traffic + ICI collective bytes);
+3. DRYRUN   — measure a real train step for the top-k survivors only;
+4. FINISH   — return the winning ``ParallelConfig`` + rules + model config.
+
+Runs identically on a virtual CPU mesh (tests, the driver's 8-device dry
+run) and on real TPU slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.transformer import TransformerConfig
+from dlrover_tpu.runtime.mesh import ParallelConfig
+
+# Per-chip peak specs used by the analytic model; CPU entries make ranking
+# meaningful (relative, not absolute) in virtual-mesh tests.
+_CHIP_SPECS = {
+    # platform-substring: (peak bf16 FLOP/s, HBM B/s, HBM bytes, ICI B/s)
+    "tpu v5 lite": (197e12, 819e9, 16e9, 4.5e10),
+    "tpu v5e": (197e12, 819e9, 16e9, 4.5e10),
+    "tpu v5p": (459e12, 2765e9, 95e9, 9e10),
+    "tpu v4": (275e12, 1228e9, 32e9, 9e10),
+    "cpu": (1e12, 100e9, 8e9, 1e10),
+}
+
+
+def chip_specs(device=None) -> Tuple[float, float, float, float]:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", device.platform).lower()
+    for key, spec in _CHIP_SPECS.items():
+        if key in kind:
+            return spec
+    return _CHIP_SPECS["cpu"] if device.platform == "cpu" else (
+        197e12, 819e9, 16e9, 4.5e10
+    )
+
+
+# Bytes of saved activation per token per layer under each remat policy
+# (bf16 residual stream multiples; see models/transformer.py policies).
+_ACT_PER_TOKEN_LAYER = {
+    "full": 1.0,        # scan carry only
+    "attn_out": 2.0,    # carry + attention branch output
+    "branch_out": 3.0,  # carry + both branch outputs
+    "dots": 8.0,        # all matmul outputs (qkv + attn + proj + wi + wo)
+    "none": 12.0,       # everything incl. elementwise
+}
+
+# Fraction of forward matmul FLOPs recomputed in the backward per policy.
+_RECOMPUTE_FRACTION = {
+    "full": 1.0,
+    "attn_out": 0.85,
+    "branch_out": 0.7,
+    "dots": 0.3,
+    "none": 0.0,
+}
+
+
+@dataclasses.dataclass
+class Candidate:
+    parallel: ParallelConfig
+    remat: str
+    est_step_time: float = math.inf
+    est_hbm_gb: float = math.inf
+    measured_step_time: Optional[float] = None
+    rejected: str = ""
+
+    def describe(self) -> str:
+        p = self.parallel
+        axes = {
+            "dp": p.data, "fsdp": p.fsdp, "tp": p.tensor,
+            "sp": p.seq, "ep": p.expert, "pp": p.pipe,
+        }
+        live = ",".join(f"{k}={v}" for k, v in axes.items() if v not in (1,))
+        return f"[{live or 'dp=1'} remat={self.remat}]"
+
+
+@dataclasses.dataclass
+class TuneResult:
+    parallel: ParallelConfig
+    model_config: TransformerConfig
+    remat: str
+    candidates: List[Candidate]
+
+    @property
+    def best(self) -> Candidate:
+        return self.candidates[0]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_candidates(
+    config: TransformerConfig,
+    n_devices: int,
+    remat_policies: Sequence[str] = ("attn_out", "branch_out", "full"),
+    max_tensor: int = 8,
+    include_pipeline: bool = True,
+) -> List[Candidate]:
+    """All legal (mesh factorization x remat) combinations.
+
+    Legality (divisibility) mirrors the reference's strategy feasibility
+    checks (ref ``atorch/auto/opt_lib``'s per-optimization
+    ``applicable``): tensor and seq must divide the head count (Ulysses
+    shards heads over seq x tensor inside attention), expert must divide
+    the expert count, pipe must divide the layer count.
+    """
+    heads = config.num_heads
+    candidates: List[Candidate] = []
+    seen = set()
+    for tensor in _divisors(n_devices):
+        if tensor > max_tensor or heads % tensor:
+            continue
+        for seq in _divisors(n_devices // tensor):
+            if seq > 1 and (heads % (seq * tensor) or config.max_seq_len % seq):
+                continue
+            for expert in _divisors(n_devices // (tensor * seq)):
+                if expert > 1 and (
+                    not config.num_experts or config.num_experts % expert
+                ):
+                    continue
+                pipes = [1]
+                if include_pipeline and not config.num_experts:
+                    pipes += [
+                        p
+                        for p in _divisors(n_devices // (tensor * seq * expert))
+                        if p > 1 and config.num_layers % p == 0
+                    ]
+                for pipe in pipes:
+                    rest = n_devices // (tensor * seq * expert * pipe)
+                    for fsdp in _divisors(rest):
+                        data = rest // fsdp
+                        key = (data, fsdp, pipe, expert, seq, tensor)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        parallel = ParallelConfig(
+                            data=data, fsdp=fsdp, pipe=pipe,
+                            expert=expert, seq=seq, tensor=tensor,
+                        )
+                        for remat in remat_policies:
+                            candidates.append(Candidate(parallel, remat))
+    return candidates
+
+
+def _estimate(
+    cand: Candidate,
+    config: TransformerConfig,
+    global_batch_size: int,
+    seq_len: int,
+    optimizer: str,
+    n_devices: int,
+) -> None:
+    """Fill est_hbm_gb / est_step_time with the analytic model.
+
+    This is the XLA-era replacement for per-candidate dry-runs: FLOP and
+    byte volumes are exact functions of shapes; only efficiency factors are
+    folded constants (measured on v5e, PROFILE.md).
+    """
+    peak_flops, hbm_bw, hbm_bytes, ici_bw = chip_specs()
+    p = cand.parallel
+    n = config.num_params()
+    tokens = global_batch_size * seq_len
+    shard = p.fsdp * p.tensor * p.pipe * max(p.expert, 1)
+
+    # ---- memory (per device) ----
+    param_b = n * 2 / shard                       # bf16 params
+    grad_b = n * 2 / shard
+    opt_mult = {"adamw": 8.0, "adafactor": 0.2, "q8_adam": 2.2,
+                "sgd": 4.0, "lion": 4.0}.get(optimizer, 8.0)
+    opt_b = n * opt_mult / shard
+    act_mult = _ACT_PER_TOKEN_LAYER.get(cand.remat, 4.0)
+    tokens_local = tokens / max(p.data * p.fsdp, 1) / max(p.seq, 1)
+    act_b = (
+        tokens_local * config.num_layers * config.d_model * 2 * act_mult
+        / max(p.tensor, 1) / max(p.pipe, 1)
+    )
+    # transient working set (attention + MLP blocks, CE chunks)
+    work_b = tokens_local * config.resolved_d_ff * 2 * 4 / max(p.tensor, 1)
+    total_b = (param_b + grad_b + opt_b + act_b + work_b) * 1.15  # frag pad
+    cand.est_hbm_gb = total_b / 2**30
+    if total_b > hbm_bytes * 0.92:
+        cand.rejected = (
+            f"est {cand.est_hbm_gb:.1f} GiB > {hbm_bytes * 0.92 / 2**30:.1f}"
+        )
+        return
+
+    # ---- time ----
+    ftok = 6 * n + 12 * config.num_layers * config.d_model * seq_len
+    flops_dev = ftok * tokens * (
+        1 + _RECOMPUTE_FRACTION.get(cand.remat, 0.5) / 3
+    ) / n_devices
+    mxu_eff = 0.55  # measured sustained efficiency at bench shapes
+    t_compute = flops_dev / (peak_flops * mxu_eff)
+    # HBM: weights stream fwd+bwd+update, activations twice
+    t_hbm = (param_b * 6 + opt_b + act_b * 2) / hbm_bw
+    # ICI: fsdp all-gather + reduce-scatter of params, dp grad all-reduce,
+    # sp/ep all-to-alls of activations
+    coll_b = 0.0
+    if p.fsdp > 1:
+        coll_b += 3 * n * 2 / shard * (p.fsdp - 1) / p.fsdp
+    if p.data > 1:
+        coll_b += 2 * n * 2 / shard * (p.data - 1) / p.data
+    if p.seq > 1 or p.expert > 1:
+        coll_b += 4 * tokens_local * config.d_model * 2
+    if p.tensor > 1:
+        coll_b += 4 * tokens_local * config.d_model * 2 * config.num_layers
+    t_ici = coll_b / ici_bw
+    # pipeline bubble: (S-1)/(T+S-1) idle fraction
+    bubble = 1.0
+    if p.pipe > 1:
+        micro = max(config.num_microbatches or p.pipe, p.pipe)
+        bubble = 1 + (p.pipe - 1) / micro
+    cand.est_step_time = (max(t_compute, t_hbm) + t_ici) * bubble
+
+
+def _measure(
+    cand: Candidate,
+    config: TransformerConfig,
+    global_batch_size: int,
+    seq_len: int,
+    optimizer: str,
+    devices,
+    steps: int = 2,
+) -> Optional[float]:
+    """One real compile + ``steps`` timed steps for a finalist candidate."""
+    from dlrover_tpu.parallel import rules as lr
+    from dlrover_tpu.runtime.mesh import build_mesh
+    from dlrover_tpu.trainer import train_lib
+
+    model_cfg = dataclasses.replace(
+        config,
+        remat=cand.remat,
+        pipeline_stages=cand.parallel.pipe,
+        num_microbatches=(
+            cand.parallel.pipe if cand.parallel.pipe > 1 else 0
+        ),
+    )
+    from dlrover_tpu.models.transformer import TransformerLM
+
+    try:
+        mesh = build_mesh(cand.parallel, devices=devices)
+        model = TransformerLM(model_cfg)
+        opt = train_lib.make_optimizer(optimizer, learning_rate=1e-4)
+        train = train_lib.build_sharded_train(
+            model, opt, mesh, lr.DEFAULT_RULES,
+            global_batch_size=global_batch_size, seq_len=seq_len,
+        )
+        state = train.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(
+            0, config.vocab_size,
+            size=(global_batch_size, seq_len + 1), dtype=np.int32,
+        )
+        batch = train_lib.shard_batch(
+            {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}, train
+        )
+        state, metrics = train.step(state, batch)  # compile + warm
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = train.step(state, batch)
+        float(metrics["loss"])
+        return (time.perf_counter() - t0) / steps
+    except Exception as e:  # noqa: BLE001 - infeasible candidate, skip
+        logger.warning("dry-run %s failed: %s", cand.describe(), str(e)[:200])
+        cand.rejected = f"dryrun: {str(e)[:120]}"
+        return None
+
+
+_REMAT_CODES = {"none": 0, "full": 1, "dots": 2, "attn_out": 3,
+                "branch_out": 4}
+
+
+def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
+    """Make host 0's winning candidate the whole world's choice."""
+    from jax.experimental import multihost_utils
+
+    p = best.parallel
+    key = np.asarray(
+        [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
+         _REMAT_CODES.get(best.remat, -1)],
+        np.int64,
+    )
+    agreed = multihost_utils.broadcast_one_to_all(key)
+    if np.array_equal(agreed, key):
+        return best
+    codes = {v: k for k, v in _REMAT_CODES.items()}
+    parallel = ParallelConfig(
+        data=int(agreed[0]), fsdp=int(agreed[1]), pipe=int(agreed[2]),
+        expert=int(agreed[3]), seq=int(agreed[4]), tensor=int(agreed[5]),
+    )
+    remat = codes.get(int(agreed[6]), best.remat)
+    for cand in ranked:
+        if cand.parallel == parallel and cand.remat == remat:
+            return cand
+    return Candidate(parallel, remat)
+
+
+def auto_tune(
+    config: TransformerConfig,
+    *,
+    global_batch_size: int,
+    seq_len: int = 0,
+    n_devices: int = 0,
+    optimizer: str = "adamw",
+    max_measure: int = 3,
+    measure: bool = True,
+    devices=None,
+    include_pipeline: bool = True,
+) -> TuneResult:
+    """Find the best (ParallelConfig, remat) for ``config`` on this mesh.
+
+    The single-call surface of the reference's
+    ``auto_accelerate(model, optim_func, ...)``; returns a ``TuneResult``
+    whose ``parallel``/``model_config`` plug straight into
+    ``build_mesh`` + ``build_sharded_train``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_devices = n_devices or len(devices)
+    devices = devices[:n_devices]
+    seq_len = seq_len or config.max_seq_len
+
+    candidates = enumerate_candidates(
+        config, n_devices, include_pipeline=include_pipeline
+    )
+    for cand in candidates:
+        _estimate(
+            cand, config, global_batch_size, seq_len, optimizer, n_devices
+        )
+    feasible = sorted(
+        (c for c in candidates if not c.rejected),
+        key=lambda c: c.est_step_time,
+    )
+    if not feasible:
+        raise ValueError(
+            f"no feasible strategy for {n_devices} devices (all "
+            f"{len(candidates)} candidates exceed memory); reduce batch or "
+            f"model size"
+        )
+    logger.info(
+        "auto_tune: %d candidates, %d feasible; top: %s",
+        len(candidates), len(feasible),
+        [c.describe() for c in feasible[:5]],
+    )
+    if measure:
+        finalists = feasible[:max_measure]
+        for cand in finalists:
+            cand.measured_step_time = _measure(
+                cand, config, global_batch_size, seq_len, optimizer, devices
+            )
+        measured = [
+            c for c in finalists if c.measured_step_time is not None
+        ]
+        ranked = sorted(
+            measured, key=lambda c: c.measured_step_time
+        ) + [c for c in feasible if c not in measured]
+    else:
+        ranked = feasible
+    best = ranked[0]
+    if jax.process_count() > 1:
+        # Hosts measure wall-clock independently; near-ties can rank
+        # differently per host, and divergent strategies compile mismatched
+        # collectives (distributed hang).  Host 0's pick is authoritative.
+        best = _broadcast_choice(best, ranked)
+    logger.info(
+        "auto_tune: selected %s (est %.3fs, measured %s)",
+        best.describe(), best.est_step_time,
+        f"{best.measured_step_time:.3f}s" if best.measured_step_time else "-",
+    )
+    model_cfg = dataclasses.replace(
+        config,
+        remat=best.remat,
+        pipeline_stages=best.parallel.pipe,
+        num_microbatches=best.parallel.pipe if best.parallel.pipe > 1 else 0,
+    )
+    return TuneResult(
+        parallel=best.parallel,
+        model_config=model_cfg,
+        remat=best.remat,
+        candidates=ranked,
+    )

@@ -1,0 +1,2 @@
+from dlrover_tpu.embedding.table import EmbeddingTable  # noqa: F401
+from dlrover_tpu.embedding.store import KVStore  # noqa: F401

@@ -1,0 +1,287 @@
+// Host-side dynamic-capacity sparse embedding store (C core).
+//
+// Capability ref: TFPlus KvVariable
+// (/root/reference/tfplus/tfplus/kv_variable/kernels/kv_variable.h:1-1021 —
+// dynamic capacity hash -> embedding row with per-key counts/timestamps and
+// full/delta export; hashmap.h cuckoo table; kernels/training_ops.cc group
+// sparse optimizer updates applied directly to rows).
+//
+// TPU redesign: the table lives in host RAM (TPU HBM holds only the rows a
+// step touches — lookups gather host->device, updates scatter back), so the
+// native piece is a plain open-addressing robin-hood-style hash keyed by
+// int64 with an inline payload:
+//   [ value(dim) | m(dim) | v(dim) ] float32  +  count u32  +  last_step u32
+// The optimizer moments sit next to the value row, which is exactly the
+// "group sparse apply" layout the reference's C++ optimizers use (one cache
+// walk per update, no second table).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kEmpty = 0x8000000000000000ULL;  // sentinel slot marker
+
+inline uint64_t mix64(uint64_t x) {
+  // splitmix64 finalizer: avalanche for bucket choice + deterministic init.
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+struct Store {
+  int64_t dim = 0;
+  int64_t capacity = 0;   // power of two
+  int64_t size = 0;
+  uint64_t* keys = nullptr;      // [capacity]
+  float* payload = nullptr;      // [capacity, 3*dim]
+  uint32_t* counts = nullptr;    // [capacity]
+  uint32_t* steps = nullptr;     // [capacity]
+
+  int64_t payload_width() const { return 3 * dim; }
+
+  void alloc(int64_t cap) {
+    capacity = cap;
+    keys = static_cast<uint64_t*>(malloc(cap * sizeof(uint64_t)));
+    payload = static_cast<float*>(calloc(cap * payload_width(), sizeof(float)));
+    counts = static_cast<uint32_t*>(calloc(cap, sizeof(uint32_t)));
+    steps = static_cast<uint32_t*>(calloc(cap, sizeof(uint32_t)));
+    for (int64_t i = 0; i < cap; ++i) keys[i] = kEmpty;
+  }
+
+  void release() {
+    free(keys); free(payload); free(counts); free(steps);
+    keys = nullptr; payload = nullptr; counts = nullptr; steps = nullptr;
+  }
+
+  int64_t find_slot(uint64_t key) const {
+    uint64_t mask = static_cast<uint64_t>(capacity) - 1;
+    uint64_t idx = mix64(key) & mask;
+    while (true) {
+      if (keys[idx] == key) return static_cast<int64_t>(idx);
+      if (keys[idx] == kEmpty) return -static_cast<int64_t>(idx) - 1;
+      idx = (idx + 1) & mask;
+    }
+  }
+
+  void grow() {
+    Store bigger;
+    bigger.dim = dim;
+    bigger.alloc(capacity * 2);
+    for (int64_t i = 0; i < capacity; ++i) {
+      if (keys[i] == kEmpty) continue;
+      int64_t slot = bigger.find_slot(keys[i]);
+      slot = -slot - 1;  // must be a miss in the fresh table
+      bigger.keys[slot] = keys[i];
+      memcpy(bigger.payload + slot * payload_width(),
+             payload + i * payload_width(),
+             payload_width() * sizeof(float));
+      bigger.counts[slot] = counts[i];
+      bigger.steps[slot] = steps[i];
+    }
+    bigger.size = size;
+    release();
+    *this = bigger;
+  }
+
+  int64_t upsert(uint64_t key, float init_scale, uint64_t seed) {
+    int64_t slot = find_slot(key);
+    if (slot >= 0) return slot;
+    if ((size + 1) * 10 >= capacity * 7) {  // load factor 0.7
+      grow();
+      slot = find_slot(key);
+    }
+    slot = -slot - 1;
+    keys[slot] = key;
+    float* row = payload + slot * payload_width();
+    // Deterministic per-key init: uniform(-s, s) from a splitmix stream.
+    uint64_t state = mix64(key ^ seed);
+    for (int64_t d = 0; d < dim; ++d) {
+      state = mix64(state);
+      float u = static_cast<float>(state >> 40) /
+                static_cast<float>(1ULL << 24);  // [0, 1)
+      row[d] = (2.0f * u - 1.0f) * init_scale;
+    }
+    // moments (m, v) start at zero via calloc/grow-copy
+    size += 1;
+    return slot;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int64_t dim, int64_t initial_capacity) {
+  Store* s = new Store();
+  s->dim = dim;
+  int64_t cap = 64;
+  while (cap < initial_capacity) cap <<= 1;
+  s->alloc(cap);
+  return s;
+}
+
+void kv_free(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  s->release();
+  delete s;
+}
+
+int64_t kv_size(void* handle) { return static_cast<Store*>(handle)->size; }
+
+int64_t kv_capacity(void* handle) {
+  return static_cast<Store*>(handle)->capacity;
+}
+
+int64_t kv_dim(void* handle) { return static_cast<Store*>(handle)->dim; }
+
+// Gather rows for `keys`, inserting missing keys with deterministic init.
+// Bumps per-key counts and last_step.  out: [n, dim].
+void kv_lookup(void* handle, const int64_t* lookup_keys, int64_t n,
+               float* out, float init_scale, uint64_t seed, uint32_t step) {
+  Store* s = static_cast<Store*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = s->upsert(static_cast<uint64_t>(lookup_keys[i]),
+                             init_scale, seed);
+    memcpy(out + i * s->dim, s->payload + slot * s->payload_width(),
+           s->dim * sizeof(float));
+    s->counts[slot] += 1;
+    s->steps[slot] = step;
+  }
+}
+
+// Read-only gather: missing keys yield zero rows and are NOT inserted
+// (inference / eval path).
+void kv_peek(void* handle, const int64_t* peek_keys, int64_t n, float* out) {
+  Store* s = static_cast<Store*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = s->find_slot(static_cast<uint64_t>(peek_keys[i]));
+    if (slot >= 0) {
+      memcpy(out + i * s->dim, s->payload + slot * s->payload_width(),
+             s->dim * sizeof(float));
+    } else {
+      memset(out + i * s->dim, 0, s->dim * sizeof(float));
+    }
+  }
+}
+
+// Overwrite value rows (import/restore path); inserts missing keys.
+void kv_insert(void* handle, const int64_t* ins_keys, int64_t n,
+               const float* rows, const float* moments_m,
+               const float* moments_v, const uint32_t* ins_counts,
+               const uint32_t* ins_steps) {
+  Store* s = static_cast<Store*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = s->upsert(static_cast<uint64_t>(ins_keys[i]), 0.0f, 0);
+    float* row = s->payload + slot * s->payload_width();
+    memcpy(row, rows + i * s->dim, s->dim * sizeof(float));
+    if (moments_m)
+      memcpy(row + s->dim, moments_m + i * s->dim, s->dim * sizeof(float));
+    if (moments_v)
+      memcpy(row + 2 * s->dim, moments_v + i * s->dim,
+             s->dim * sizeof(float));
+    if (ins_counts) s->counts[slot] = ins_counts[i];
+    if (ins_steps) s->steps[slot] = ins_steps[i];
+  }
+}
+
+// Group-sparse Adam applied directly to the rows (ref training_ops.cc
+// KvVariableGroupSparseApplyAdamV2): one walk updates value + moments.
+// Repeated keys in one batch are applied sequentially (gradient order).
+void kv_apply_group_adam(void* handle, const int64_t* upd_keys, int64_t n,
+                         const float* grads, float lr, float b1, float b2,
+                         float eps, float weight_decay, int64_t t) {
+  Store* s = static_cast<Store*>(handle);
+  float bias1 = 1.0f - powf(b1, static_cast<float>(t));
+  float bias2 = 1.0f - powf(b2, static_cast<float>(t));
+  float scale = sqrtf(bias2) / bias1;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = s->find_slot(static_cast<uint64_t>(upd_keys[i]));
+    if (slot < 0) continue;  // never looked up: no grad should exist
+    float* row = s->payload + slot * s->payload_width();
+    float* m = row + s->dim;
+    float* v = row + 2 * s->dim;
+    const float* g = grads + i * s->dim;
+    for (int64_t d = 0; d < s->dim; ++d) {
+      float gd = g[d] + weight_decay * row[d];
+      m[d] = b1 * m[d] + (1.0f - b1) * gd;
+      v[d] = b2 * v[d] + (1.0f - b2) * gd * gd;
+      row[d] -= lr * scale * m[d] / (sqrtf(v[d]) + eps);
+    }
+  }
+}
+
+// Export up to `cap` entries (all when min_step == 0, else only entries
+// touched at or after min_step — the delta-export path).  Returns the
+// number written.  Arrays may be null to export keys only.
+int64_t kv_export(void* handle, uint32_t min_step, int64_t* out_keys,
+                  float* out_rows, float* out_m, float* out_v,
+                  uint32_t* out_counts, uint32_t* out_steps, int64_t cap) {
+  Store* s = static_cast<Store*>(handle);
+  int64_t written = 0;
+  for (int64_t i = 0; i < s->capacity && written < cap; ++i) {
+    if (s->keys[i] == kEmpty) continue;
+    if (min_step && s->steps[i] < min_step) continue;
+    if (out_keys) out_keys[written] = static_cast<int64_t>(s->keys[i]);
+    const float* row = s->payload + i * s->payload_width();
+    if (out_rows)
+      memcpy(out_rows + written * s->dim, row, s->dim * sizeof(float));
+    if (out_m)
+      memcpy(out_m + written * s->dim, row + s->dim, s->dim * sizeof(float));
+    if (out_v)
+      memcpy(out_v + written * s->dim, row + 2 * s->dim,
+             s->dim * sizeof(float));
+    if (out_counts) out_counts[written] = s->counts[i];
+    if (out_steps) out_steps[written] = s->steps[i];
+    written += 1;
+  }
+  return written;
+}
+
+int64_t kv_count_since(void* handle, uint32_t min_step) {
+  Store* s = static_cast<Store*>(handle);
+  int64_t n = 0;
+  for (int64_t i = 0; i < s->capacity; ++i) {
+    if (s->keys[i] == kEmpty) continue;
+    if (min_step && s->steps[i] < min_step) continue;
+    n += 1;
+  }
+  return n;
+}
+
+// Evict entries not touched since `min_step` with fewer than `min_count`
+// hits (feature-freshness eviction, ref kv_variable.h delete/filter ops).
+// Rebuilds the table; returns evicted count.
+int64_t kv_evict(void* handle, uint32_t min_step, uint32_t min_count) {
+  Store* s = static_cast<Store*>(handle);
+  Store fresh;
+  fresh.dim = s->dim;
+  fresh.alloc(s->capacity);
+  int64_t evicted = 0;
+  for (int64_t i = 0; i < s->capacity; ++i) {
+    if (s->keys[i] == kEmpty) continue;
+    if (s->steps[i] < min_step && s->counts[i] < min_count) {
+      evicted += 1;
+      continue;
+    }
+    int64_t slot = fresh.find_slot(s->keys[i]);
+    slot = -slot - 1;
+    fresh.keys[slot] = s->keys[i];
+    memcpy(fresh.payload + slot * fresh.payload_width(),
+           s->payload + i * s->payload_width(),
+           s->payload_width() * sizeof(float));
+    fresh.counts[slot] = s->counts[i];
+    fresh.steps[slot] = s->steps[i];
+    fresh.size += 1;
+  }
+  s->release();
+  *s = fresh;
+  return evicted;
+}
+
+}  // extern "C"

@@ -1,0 +1,262 @@
+"""KVStore: dynamic-capacity sparse embedding store (ctypes over C++).
+
+The Python face of ``native/kv_store.cc`` (capability ref
+``tfplus/tfplus/kv_variable/kernels/kv_variable.h`` — see the .cc header).
+The shared library is compiled with g++ on first use and cached next to the
+source; a NumPy fallback implements the identical contract when no compiler
+is available (CI safety net — the native path is the product).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "kv_store.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libkvstore.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                    check=True, capture_output=True, text=True,
+                )
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, subprocess.CalledProcessError) as e:
+            logger.warning(
+                "kv_store native build unavailable (%s); using the NumPy "
+                "fallback", getattr(e, "stderr", e),
+            )
+            _lib_failed = True
+            return None
+        c = ctypes
+        i64, u32, u64, f32p = c.c_int64, c.c_uint32, c.c_uint64, c.POINTER(c.c_float)
+        i64p, u32p = c.POINTER(c.c_int64), c.POINTER(c.c_uint32)
+        lib.kv_create.restype = c.c_void_p
+        lib.kv_create.argtypes = [i64, i64]
+        lib.kv_free.argtypes = [c.c_void_p]
+        for name in ("kv_size", "kv_capacity", "kv_dim"):
+            getattr(lib, name).restype = i64
+            getattr(lib, name).argtypes = [c.c_void_p]
+        lib.kv_lookup.argtypes = [c.c_void_p, i64p, i64, f32p, c.c_float, u64, u32]
+        lib.kv_peek.argtypes = [c.c_void_p, i64p, i64, f32p]
+        lib.kv_insert.argtypes = [c.c_void_p, i64p, i64, f32p, f32p, f32p, u32p, u32p]
+        lib.kv_apply_group_adam.argtypes = [
+            c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float, c.c_float,
+            c.c_float, c.c_float, i64,
+        ]
+        lib.kv_export.restype = i64
+        lib.kv_export.argtypes = [
+            c.c_void_p, u32, i64p, f32p, f32p, f32p, u32p, u32p, i64,
+        ]
+        lib.kv_count_since.restype = i64
+        lib.kv_count_since.argtypes = [c.c_void_p, u32]
+        lib.kv_evict.restype = i64
+        lib.kv_evict.argtypes = [c.c_void_p, u32, u32]
+        _lib = lib
+    return _lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class KVStore:
+    """Dynamic sparse table: int64 key -> (value, adam m/v, count, step)."""
+
+    def __init__(self, dim: int, initial_capacity: int = 1024,
+                 native: Optional[bool] = None):
+        self.dim = int(dim)
+        lib = _load_native() if native in (None, True) else None
+        if native is True and lib is None:
+            raise RuntimeError("native kv_store requested but unavailable")
+        self._lib = lib
+        if lib is not None:
+            self._handle = lib.kv_create(self.dim, initial_capacity)
+        else:
+            self._py: Dict[int, np.ndarray] = {}
+            self._py_meta: Dict[int, Tuple[int, int]] = {}  # count, step
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def __len__(self) -> int:
+        if self._lib:
+            return int(self._lib.kv_size(self._handle))
+        return len(self._py)
+
+    def close(self):
+        if self._lib is not None and self._handle:
+            self._lib.kv_free(self._handle)
+            self._handle = None
+
+    # -- core ops -------------------------------------------------------------
+
+    def lookup(self, keys: np.ndarray, init_scale: float = 0.01,
+               seed: int = 0, step: int = 0) -> np.ndarray:
+        """Gather rows, inserting missing keys (deterministic init)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.empty((keys.size, self.dim), np.float32)
+        if self._lib:
+            self._lib.kv_lookup(
+                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
+                _ptr(out, ctypes.c_float), init_scale, seed, step,
+            )
+            return out
+        for i, key in enumerate(keys.tolist()):
+            row = self._py.get(key)
+            if row is None:
+                rng = np.random.default_rng(np.uint64(key) ^ np.uint64(seed))
+                row = np.zeros((3, self.dim), np.float32)
+                row[0] = rng.uniform(
+                    -init_scale, init_scale, self.dim
+                ).astype(np.float32)
+                self._py[key] = row
+                self._py_meta[key] = (0, 0)
+            out[i] = row[0]
+            count, _ = self._py_meta[key]
+            self._py_meta[key] = (count + 1, step)
+        return out
+
+    def peek(self, keys: np.ndarray) -> np.ndarray:
+        """Read-only gather; missing keys yield zeros (eval path)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.zeros((keys.size, self.dim), np.float32)
+        if self._lib:
+            self._lib.kv_peek(
+                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
+                _ptr(out, ctypes.c_float),
+            )
+            return out
+        for i, key in enumerate(keys.tolist()):
+            row = self._py.get(key)
+            if row is not None:
+                out[i] = row[0]
+        return out
+
+    def apply_group_adam(self, keys: np.ndarray, grads: np.ndarray,
+                         lr: float, b1: float = 0.9, b2: float = 0.999,
+                         eps: float = 1e-8, weight_decay: float = 0.0,
+                         t: int = 1):
+        """Sparse Adam on the touched rows (moments live in the store)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        assert grads.shape == (keys.size, self.dim)
+        if self._lib:
+            self._lib.kv_apply_group_adam(
+                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
+                _ptr(grads, ctypes.c_float), lr, b1, b2, eps,
+                weight_decay, t,
+            )
+            return
+        scale = np.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+        for i, key in enumerate(keys.tolist()):
+            row = self._py.get(key)
+            if row is None:
+                continue
+            g = grads[i] + weight_decay * row[0]
+            row[1] = b1 * row[1] + (1 - b1) * g
+            row[2] = b2 * row[2] + (1 - b2) * g * g
+            row[0] -= lr * scale * row[1] / (np.sqrt(row[2]) + eps)
+
+    # -- export / import / eviction -------------------------------------------
+
+    def export(self, min_step: int = 0):
+        """(keys, values, m, v, counts, steps); ``min_step`` selects the
+        delta touched at/after that step (0 = full export)."""
+        if self._lib:
+            cap = int(self._lib.kv_count_since(self._handle, min_step))
+            keys = np.empty(cap, np.int64)
+            rows = np.empty((cap, self.dim), np.float32)
+            m = np.empty((cap, self.dim), np.float32)
+            v = np.empty((cap, self.dim), np.float32)
+            counts = np.empty(cap, np.uint32)
+            steps = np.empty(cap, np.uint32)
+            n = int(self._lib.kv_export(
+                self._handle, min_step, _ptr(keys, ctypes.c_int64),
+                _ptr(rows, ctypes.c_float), _ptr(m, ctypes.c_float),
+                _ptr(v, ctypes.c_float), _ptr(counts, ctypes.c_uint32),
+                _ptr(steps, ctypes.c_uint32), cap,
+            ))
+            return (keys[:n], rows[:n], m[:n], v[:n], counts[:n], steps[:n])
+        items = [
+            (k, *self._py[k], *self._py_meta[k]) for k in sorted(self._py)
+            if not min_step or self._py_meta[k][1] >= min_step
+        ]
+        if not items:
+            empty = np.empty((0, self.dim), np.float32)
+            return (np.empty(0, np.int64), empty, empty.copy(),
+                    empty.copy(), np.empty(0, np.uint32),
+                    np.empty(0, np.uint32))
+        keys = np.asarray([it[0] for it in items], np.int64)
+        rows = np.stack([it[1] for it in items])
+        m = np.stack([it[2] for it in items])
+        v = np.stack([it[3] for it in items])
+        counts = np.asarray([it[4] for it in items], np.uint32)
+        steps = np.asarray([it[5] for it in items], np.uint32)
+        return keys, rows, m, v, counts, steps
+
+    def insert(self, keys, rows, m=None, v=None, counts=None, steps=None):
+        keys = np.ascontiguousarray(keys, np.int64)
+        rows = np.ascontiguousarray(rows, np.float32)
+        if self._lib:
+            self._lib.kv_insert(
+                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
+                _ptr(rows, ctypes.c_float),
+                _ptr(np.ascontiguousarray(m, np.float32), ctypes.c_float)
+                if m is not None else None,
+                _ptr(np.ascontiguousarray(v, np.float32), ctypes.c_float)
+                if v is not None else None,
+                _ptr(np.ascontiguousarray(counts, np.uint32), ctypes.c_uint32)
+                if counts is not None else None,
+                _ptr(np.ascontiguousarray(steps, np.uint32), ctypes.c_uint32)
+                if steps is not None else None,
+            )
+            return
+        for i, key in enumerate(keys.tolist()):
+            row = np.zeros((3, self.dim), np.float32)
+            row[0] = rows[i]
+            if m is not None:
+                row[1] = m[i]
+            if v is not None:
+                row[2] = v[i]
+            self._py[key] = row
+            self._py_meta[key] = (
+                int(counts[i]) if counts is not None else 0,
+                int(steps[i]) if steps is not None else 0,
+            )
+
+    def evict(self, min_step: int, min_count: int = 0) -> int:
+        """Drop stale, cold features; returns evicted count."""
+        if self._lib:
+            return int(self._lib.kv_evict(self._handle, min_step, min_count))
+        stale = [
+            k for k, (count, step) in self._py_meta.items()
+            if step < min_step and count < min_count
+        ]
+        for k in stale:
+            del self._py[k]
+            del self._py_meta[k]
+        return len(stale)

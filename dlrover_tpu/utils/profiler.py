@@ -1,0 +1,212 @@
+"""Step profiler: per-op / per-module device-time breakdown + MFU.
+
+Capability ref: ATorch's ``AProfiler``
+(``atorch/atorch/utils/prof.py:38-823`` — per-module FLOPs/duration tables,
+``print_model_profile``, ``compute_gpu_utilization``) and its trace parsing
+(``utils/parse_trace_json.py``).
+
+TPU redesign: modules are not instrumented with hooks (under jit they do not
+exist at runtime) — instead one profiled window is captured with
+``jax.profiler`` and the xplane-derived Chrome trace is parsed back into a
+table keyed by the op's HLO metadata path (``.../blocks/attn/...``), which
+recovers the module structure from the compiled program.  This is exactly
+the workflow that produced PROFILE.md, packaged as a library.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+
+
+@dataclasses.dataclass
+class OpProfile:
+    name: str
+    time_s: float
+    count: int
+    detail: str = ""
+
+    @property
+    def module(self) -> str:
+        """Module-ish path recovered from HLO metadata in ``detail``."""
+        m = re.search(r'op_name="[^"]*?((?:[\w.]+/)*[\w.]+)"', self.detail)
+        if not m:
+            return _classify(self.name)
+        path = m.group(1)
+        # strip transform prefixes: jit(_train_step)/jvp(Model)/while/body/..
+        parts = [
+            p for p in path.split("/")
+            if not re.match(r"(jit|jvp|transpose|while|body|closed_call|"
+                            r"checkpoint|remat\d*)\b", p)
+            and "(" not in p
+        ]
+        return "/".join(parts[:3]) if parts else _classify(self.name)
+
+
+def _classify(op_name: str) -> str:
+    for key, label in (
+        ("attn", "attention-kernel"),
+        ("convolution", "matmul"),
+        ("dot", "matmul"),
+        ("dynamic-update-slice", "grad-accumulate"),
+        ("all-reduce", "collective"),
+        ("all-gather", "collective"),
+        ("all-to-all", "collective"),
+        ("collective", "collective"),
+        ("copy", "copy"),
+        ("fusion", "fusion"),
+    ):
+        if key in op_name:
+            return label
+    return "other"
+
+
+@dataclasses.dataclass
+class StepProfile:
+    steps: int
+    wall_s: float
+    device_total_s: float
+    ops: List[OpProfile]
+
+    def per_step(self) -> float:
+        return self.device_total_s / max(self.steps, 1)
+
+    def by_module(self) -> Dict[str, float]:
+        table: Dict[str, float] = collections.defaultdict(float)
+        for op in self.ops:
+            table[op.module] += op.time_s
+        return dict(sorted(table.items(), key=lambda kv: -kv[1]))
+
+    def mfu(self, flops_per_step: float, peak_flops: float) -> float:
+        step_s = self.per_step()
+        return flops_per_step / (peak_flops * step_s) if step_s else 0.0
+
+    def table(self, top: int = 20) -> str:
+        """Human-readable profile (the ``print_model_profile`` analogue)."""
+        lines = [
+            f"device time/step: {self.per_step():.4f}s "
+            f"(wall {self.wall_s:.2f}s over {self.steps} steps)",
+            f"{'s/step':>10}  {'share':>6}  {'n':>5}  op / module",
+        ]
+        step_total = max(self.per_step(), 1e-12)
+        for op in sorted(self.ops, key=lambda o: -o.time_s)[:top]:
+            per = op.time_s / self.steps
+            lines.append(
+                f"{per:10.4f}  {per / step_total:6.1%}  "
+                f"{op.count:5d}  {op.name}  [{op.module}]"
+            )
+        lines.append("-- by module --")
+        for module, t in list(self.by_module().items())[:top]:
+            per = t / self.steps
+            lines.append(f"{per:10.4f}  {per / step_total:6.1%}  {module}")
+        return "\n".join(lines)
+
+
+def parse_chrome_trace(path: str, steps: int, wall_s: float) -> StepProfile:
+    """Aggregate device-lane op durations from a jax profiler trace."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pid_names = {
+        e["pid"]: str(e["args"].get("name", ""))
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "args" in e
+    }
+    device_pids = {
+        pid for pid, name in pid_names.items()
+        if "TPU" in name or "GPU" in name or "/device:" in name
+    }
+    dur: Dict[str, float] = collections.Counter()
+    cnt: Dict[str, int] = collections.Counter()
+    detail: Dict[str, str] = {}
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = e["name"]
+        # Skip the envelope rows (whole-program and while-loop spans) so the
+        # leaf table sums to the device time once, not 3x.
+        if name.startswith("jit_") or re.fullmatch(r"while\.\d+|\d+", name):
+            continue
+        d = float(e.get("dur", 0)) / 1e6
+        dur[name] += d
+        cnt[name] += 1
+        total += d
+        if name not in detail:
+            args = e.get("args", {})
+            detail[name] = str(
+                args.get("long_name") or args.get("tf_op") or ""
+            )
+    ops = [
+        OpProfile(name, dur[name], cnt[name], detail.get(name, ""))
+        for name in dur
+    ]
+    return StepProfile(
+        steps=steps, wall_s=wall_s, device_total_s=total, ops=ops
+    )
+
+
+def find_trace_file(trace_dir: str) -> Optional[str]:
+    hits = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+        )
+        + glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json"), recursive=True
+        )
+    )
+    return hits[-1] if hits else None
+
+
+def capture(
+    step_fn: Callable,
+    args: Sequence,
+    steps: int = 3,
+    trace_dir: Optional[str] = None,
+    sync: Optional[Callable] = None,
+) -> StepProfile:
+    """Profile ``steps`` invocations of a compiled step function.
+
+    ``step_fn(*args)`` should return something whose first leaf can be
+    fetched to synchronize (or pass an explicit ``sync(out)``).  Warm up
+    (compile) before calling this.
+    """
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="dlrover_prof_")
+    out = step_fn(*args)
+    _sync(out, sync)
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step_fn(*args)
+    _sync(out, sync)
+    wall = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+    path = find_trace_file(trace_dir)
+    if path is None:
+        return StepProfile(steps=steps, wall_s=wall, device_total_s=0.0, ops=[])
+    return parse_chrome_trace(path, steps, wall)
+
+
+def _sync(out, sync):
+    if sync is not None:
+        sync(out)
+        return
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        # float() forces a device->host read; block_until_ready alone does
+        # not reliably synchronize on the remote TPU relay.
+        import numpy as np
+
+        np.asarray(jax.device_get(leaves[0])).reshape(-1)[:1]

@@ -1,0 +1,78 @@
+"""auto_tune strategy search on the virtual 8-device CPU mesh.
+
+Mirrors the reference's auto_accelerate tests
+(ref ``atorch/atorch/tests/common_tests/auto_accelerate_test.py``): the
+search must produce a feasible, runnable strategy without hand-picking.
+"""
+
+import jax
+import pytest
+
+from dlrover_tpu.auto import auto_tune
+from dlrover_tpu.auto.tune import Candidate, enumerate_candidates
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.llama import moe_llama_config
+
+
+def tiny_cfg(**kw):
+    return gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=4,
+        vocab_size=512, max_seq_len=64, **kw,
+    )
+
+
+def test_enumeration_respects_divisibility():
+    cands = enumerate_candidates(tiny_cfg(), 8)
+    assert cands
+    for c in cands:
+        sizes = c.parallel.sizes(8)
+        assert sizes["tensor"] in (1, 2, 4)  # must divide 4 heads
+        if c.parallel.seq > 1:
+            assert 4 % (c.parallel.seq * c.parallel.tensor) == 0
+        assert c.parallel.expert == 1  # dense model: no ep
+        if c.parallel.pipe > 1:
+            assert 2 % c.parallel.pipe == 0
+
+
+def test_enumeration_moe_gets_expert_axis():
+    cfg = moe_llama_config(
+        "tiny", num_experts=2, num_layers=2, vocab_size=512, max_seq_len=64
+    )
+    cands = enumerate_candidates(cfg, 8)
+    assert any(c.parallel.expert == 2 for c in cands)
+    # MoE pipeline is unsupported (pipeline.py guard): never enumerated.
+    assert all(c.parallel.pipe == 1 for c in cands)
+
+
+def test_auto_tune_picks_runnable_strategy():
+    n = min(8, len(jax.devices()))
+    result = auto_tune(
+        tiny_cfg(),
+        global_batch_size=16,
+        n_devices=n,
+        optimizer="adamw",
+        max_measure=2,
+    )
+    assert result.parallel.sizes(n)  # multiplies to n
+    assert result.best.measured_step_time is not None
+    assert result.model_config.remat == result.remat
+    # Ranked record doubles as the strategy report (dryrun evidence).
+    assert result.candidates[0].est_step_time > 0
+
+
+def test_auto_tune_memory_pruning_rejects_oversized():
+    """A model far beyond HBM at dp=1 must push the search toward sharded
+    strategies or fail loudly — never silently pick an OOM config."""
+    big = gpt2_config("1.5b", max_seq_len=1024)
+    cands = enumerate_candidates(big, 8, remat_policies=("none",))
+    from dlrover_tpu.auto.tune import _estimate
+
+    dp_only = [
+        c for c in cands
+        if c.parallel.data == 8 and c.parallel.fsdp == 1
+    ]
+    assert dp_only
+    # On CPU specs (8 GB budget in the model table) a 1.5B adamw state
+    # with remat=none cannot fit a single device's share.
+    _estimate(dp_only[0], big, 64, 1024, "adamw", 8)
+    assert dp_only[0].rejected

@@ -1,0 +1,205 @@
+"""Diagnosis subsystem: observation -> inference chain -> remediation.
+
+Capability ref: ``dlrover/python/master/diagnosis/``
+(``inferencechain/inference_chain.py:28-62`` rule engine,
+``operator/check_training_hang_operator.py:26`` hang rule,
+``diagnosis.py`` manager loop) and the in-trainer
+``atorch/atorch/fault_tolerance/hanging_detector.py:86-137``.
+
+One pass of the chain turns master-side observations (speed monitor,
+metrics time series, node inventory) into prioritized actions the master
+executes: restart the world, relaunch a node, or surface a report.  Each
+operator is independent and composable — adding a diagnosis rule is adding
+one class with ``observe``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ActionType:
+    NONE = "none"
+    RESTART_WORLD = "restart_world"   # break rendezvous; agents restart
+    RELAUNCH_NODE = "relaunch_node"   # node-level relaunch via launcher
+    REPORT = "report"                 # surfaced only (operator judgment)
+
+
+@dataclasses.dataclass
+class DiagnosisAction:
+    action: str
+    reason: str
+    node_id: int = -1
+    severity: int = 0   # higher wins when actions conflict
+
+
+class InferenceOperator:
+    """One diagnosis rule: look at the master state, emit actions."""
+
+    name = "base"
+
+    def observe(self, ctx: "DiagnosisContext") -> List[DiagnosisAction]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class DiagnosisContext:
+    """The read surface operators see (no direct mutation)."""
+
+    speed_monitor: object
+    metrics: object
+    node_manager: object
+    hang_threshold: float = 300.0
+    resource_stale_s: float = 300.0
+
+
+class TrainingHangOperator(InferenceOperator):
+    """No global-step progress past the threshold while nodes look alive:
+    a wedged collective or data stall — restart the world."""
+
+    name = "training_hang"
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        sm = ctx.speed_monitor
+        if not ctx.hang_threshold or sm.global_step == 0:
+            return []
+        stalled = sm.no_progress_for()
+        if stalled <= ctx.hang_threshold:
+            return []
+        return [
+            DiagnosisAction(
+                ActionType.RESTART_WORLD,
+                reason=(
+                    f"no step progress for {stalled:.0f}s "
+                    f"(> {ctx.hang_threshold:.0f}s)"
+                ),
+                severity=2,
+            )
+        ]
+
+
+class ResourceStallOperator(InferenceOperator):
+    """A node that heartbeats but stopped reporting resources is wedged
+    below the agent (stuck trainer, dead monitor thread): flag it; paired
+    with a hang it upgrades to a relaunch."""
+
+    name = "resource_stall"
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        if ctx.metrics is None:
+            return []
+        stale = ctx.metrics.stale_nodes(ctx.resource_stale_s)
+        return [
+            DiagnosisAction(
+                ActionType.REPORT,
+                reason=f"node {node} stopped reporting resources",
+                node_id=node,
+                severity=1,
+            )
+            for node in stale
+        ]
+
+
+class NodeFlappingOperator(InferenceOperator):
+    """A node burning through its relaunch budget is probably bad hardware:
+    surface it before the budget silently fails the job (ref
+    ``_should_relaunch`` exit-code classification)."""
+
+    name = "node_flapping"
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        out = []
+        for node_id, state in getattr(ctx.node_manager, "_nodes", {}).items():
+            if state.relaunch_count >= max(1, state.max_relaunches - 1):
+                out.append(
+                    DiagnosisAction(
+                        ActionType.REPORT,
+                        reason=(
+                            f"node {node_id} relaunched "
+                            f"{state.relaunch_count}x (budget "
+                            f"{state.max_relaunches}) — suspect hardware"
+                        ),
+                        node_id=node_id,
+                        severity=1,
+                    )
+                )
+        return out
+
+
+class InferenceChain:
+    """Run the operators, combine evidence, rank the produced actions.
+
+    Cross-rule inference (the "chain" in the reference's InferenceChain): a
+    hang observed TOGETHER with a node that stopped reporting resources
+    localizes the fault — the stalled node is relaunched instead of (only)
+    restarting the world blind.
+    """
+
+    def __init__(self, operators: Optional[List[InferenceOperator]] = None):
+        self.operators = operators or [
+            TrainingHangOperator(),
+            ResourceStallOperator(),
+            NodeFlappingOperator(),
+        ]
+
+    def infer(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        actions: List[DiagnosisAction] = []
+        for op in self.operators:
+            try:
+                actions.extend(op.observe(ctx))
+            except Exception as e:  # noqa: BLE001 - one rule must not kill all
+                logger.warning("diagnosis operator %s failed: %s", op.name, e)
+        hang = any(a.action == ActionType.RESTART_WORLD for a in actions)
+        if hang:
+            for action in actions:
+                if (
+                    action.action == ActionType.REPORT
+                    and "stopped reporting resources" in action.reason
+                ):
+                    action.action = ActionType.RELAUNCH_NODE
+                    action.reason += " during a training hang"
+                    action.severity = 3
+        return sorted(actions, key=lambda a: -a.severity)
+
+
+class DiagnosisManager:
+    """Periodic chain execution + remediation bookkeeping for the master."""
+
+    def __init__(
+        self,
+        chain: Optional[InferenceChain] = None,
+        cooldown_s: float = 120.0,
+    ):
+        self.chain = chain or InferenceChain()
+        self.cooldown_s = cooldown_s
+        self._last_remediation = 0.0
+        self.reports: List[DiagnosisAction] = []
+        self._seen_reports: set = set()
+
+    def run(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        """Returns the actions the caller should EXECUTE (cooldown-gated);
+        REPORT actions are recorded once per distinct finding on
+        ``self.reports`` (a persistent condition must not re-log every
+        control tick)."""
+        actions = self.chain.infer(ctx)
+        to_execute = []
+        now = time.monotonic()
+        for action in actions:
+            if action.action == ActionType.REPORT:
+                key = (action.node_id, action.reason)
+                if key in self._seen_reports:
+                    continue
+                self._seen_reports.add(key)
+                if len(self._seen_reports) > 1000:
+                    self._seen_reports.clear()
+                self.reports.append(action)
+                self.reports = self.reports[-100:]
+                logger.warning("diagnosis: %s", action.reason)
+            elif now - self._last_remediation >= self.cooldown_s:
+                self._last_remediation = now
+                to_execute.append(action)
+        return to_execute

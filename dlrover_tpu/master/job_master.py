@@ -42,6 +42,7 @@ class JobMaster:
         heartbeat_timeout: float = 0.0,
         hang_threshold: float = 0.0,
         auto_scale: bool = True,
+        state_path: str = "",
     ):
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager()
@@ -65,7 +66,20 @@ class JobMaster:
         # Hang remediation (ref CheckTrainingHangOperator +
         # atorch HangingDetector): 0 disables.
         self.hang_threshold = hang_threshold
-        self._last_hang_fix = 0.0
+        from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+        # Remediation re-fire gate keeps the pre-diagnosis semantics: wait
+        # at least hang_threshold between world restarts (a restore slower
+        # than a short fixed cooldown must not be re-broken mid-restore).
+        self.diagnosis = DiagnosisManager(
+            cooldown_s=hang_threshold or 120.0
+        )
+        # Master-restart persistence (ref util/state/store_mananger.py).
+        self._state_store = None
+        if state_path:
+            from dlrover_tpu.master.state_store import MasterStateStore
+
+            self._state_store = MasterStateStore(state_path)
         elastic = ElasticTrainingRendezvousManager()
         netcheck = NetworkCheckRendezvousManager()
         for manager in (elastic, netcheck):
@@ -94,6 +108,10 @@ class JobMaster:
         self._server, self.port = start_master_server(self.servicer, self.port)
 
     def start(self):
+        # Restore BEFORE the gRPC server opens: a reconnecting agent racing
+        # the restore could fetch a shard that the restore then clobbers.
+        if self._state_store is not None:
+            self._state_store.restore(self)
         if self._server is None:
             self.prepare()
         self._loop_thread = threading.Thread(
@@ -112,35 +130,36 @@ class JobMaster:
                 self.task_manager.reassign_timeout_tasks()
                 if self.auto_scaler is not None:
                     self.auto_scaler.step()
-                self._check_training_hang()
+                self._run_diagnosis()
+                if self._state_store is not None:
+                    self._state_store.save(self)
             except Exception as e:
                 logger.warning("master control loop error: %s", e)
             self._stop.wait(self.CONTROL_LOOP_INTERVAL)
 
-    def _check_training_hang(self):
-        """Act on a stalled job (ref ``check_training_hang_operator.py:26``,
-        atorch ``hanging_detector.py:86-137``): when no step has advanced
-        for ``hang_threshold`` seconds, break the sealed world so every
-        agent checkpoints and restarts its trainer."""
-        if not self.hang_threshold:
-            return
-        sm = self.speed_monitor
-        if sm.global_step == 0:
-            return  # still initializing; rendezvous timeouts cover this
-        stalled = sm.no_progress_for()
-        now = time.monotonic()
-        if (
-            stalled > self.hang_threshold
-            and now - self._last_hang_fix > self.hang_threshold
-        ):
-            self._last_hang_fix = now
-            logger.error(
-                "training hang: no step for %.0fs (> %.0fs); forcing a "
-                "world restart", stalled, self.hang_threshold,
-            )
-            for manager in self.rdzv_managers.values():
-                manager.invalidate_world()
-            self.speed_monitor.reset_running_speed()
+    def _run_diagnosis(self):
+        """One inference-chain pass; execute what it prescribes (ref
+        ``inference_chain.py:28-62`` + ``check_training_hang_operator``)."""
+        from dlrover_tpu.master.diagnosis import (
+            ActionType,
+            DiagnosisContext,
+        )
+
+        ctx = DiagnosisContext(
+            speed_monitor=self.speed_monitor,
+            metrics=self.metrics,
+            node_manager=self.node_manager,
+            hang_threshold=self.hang_threshold,
+        )
+        for action in self.diagnosis.run(ctx):
+            logger.error("diagnosis remediation: %s (%s)",
+                         action.action, action.reason)
+            if action.action == ActionType.RESTART_WORLD:
+                for manager in self.rdzv_managers.values():
+                    manager.invalidate_world()
+                self.speed_monitor.reset_running_speed()
+            elif action.action == ActionType.RELAUNCH_NODE:
+                self.node_manager.launch_node(action.node_id)
 
     def _handle_node_death(self, node_id: int):
         """Silent host death (heartbeat timeout) gets the same recovery as a

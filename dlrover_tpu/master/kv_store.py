@@ -26,6 +26,11 @@ class KVStore:
         with self._cv:
             return self._store.get(key)
 
+    def snapshot(self) -> Dict[str, bytes]:
+        """Consistent copy for persistence (RPC threads mutate the store)."""
+        with self._cv:
+            return dict(self._store)
+
     def wait(self, key: str, timeout: float = 60.0) -> Optional[bytes]:
         deadline = time.monotonic() + timeout
         with self._cv:

@@ -285,6 +285,17 @@ class NodeManager:
         with self._lock:
             return {i: n.status.value for i, n in self._nodes.items()}
 
+    def snapshot(self) -> Dict[int, Dict]:
+        """Consistent inventory copy for persistence."""
+        with self._lock:
+            return {
+                i: {
+                    "status": n.status.value,
+                    "relaunch_count": n.relaunch_count,
+                }
+                for i, n in self._nodes.items()
+            }
+
     def all_succeeded(self) -> bool:
         with self._lock:
             return all(

@@ -68,6 +68,7 @@ class TransformerConfig:
     scan_layers: bool = True
     scan_unroll: int = 1           # layers per scan iteration (XLA overlap)
     logits_dtype: Any = jnp.float32
+    logit_scale: float = 1.0       # µP output multiplier (optimizers/mup.py)
     # Pipeline parallelism (see parallel/pipeline.py): stages must divide
     # num_layers; microbatches default to the stage count.
     pipeline_stages: int = 1
@@ -325,7 +326,11 @@ class TransformerLM(nn.Module):
         x = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_final")(x)
         if return_hidden:
             # Caller computes the loss head itself (chunked CE path) — the
-            # [B, S, V] logits tensor is never materialized.
+            # [B, S, V] logits tensor is never materialized.  The µP logit
+            # multiplier folds into the hidden states so chunked CE sees
+            # the same scaled logits as the materialized path.
+            if cfg.logit_scale != 1.0:
+                x = x * cfg.logit_scale
             return x, aux * cfg.moe_aux_weight
         if cfg.tie_embeddings:
             logits = embed.attend(x)
@@ -341,4 +346,6 @@ class TransformerLM(nn.Module):
         logits = nn.with_logical_constraint(
             logits, (lr.BATCH, lr.ACT_SEQ, lr.VOCAB)
         )
+        if cfg.logit_scale != 1.0:
+            logits = logits * cfg.logit_scale
         return logits.astype(cfg.logits_dtype), aux * cfg.moe_aux_weight

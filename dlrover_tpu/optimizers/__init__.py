@@ -1,0 +1,3 @@
+from dlrover_tpu.optimizers.agd import agd  # noqa: F401
+from dlrover_tpu.optimizers.wsam import make_wsam_step  # noqa: F401
+from dlrover_tpu.optimizers.mup import mup_scale, mup_config  # noqa: F401

@@ -1,0 +1,70 @@
+"""µP (maximal-update parametrization) support.
+
+Capability ref: ``atorch/atorch/mup/`` (infshape.py / init.py / optim.py —
+per-parameter infinite-shape bookkeeping patched into torch modules and
+optimizers).  The jax redesign needs none of the module surgery: widths are
+static facts of the config, so µP reduces to (a) a logit multiplier on the
+model (``TransformerConfig.logit_scale``) and (b) a per-leaf update scaling
+transform chained onto any optax optimizer.
+
+Recipe (Adam-style, Tensor Programs V): relative to a ``base`` width,
+matrix-like hidden parameters (both fan dims grow with width) take
+lr x 1/width_mult; vector-like parameters (embeddings, biases, norms)
+keep the base lr; output logits are scaled by 1/width_mult.  Hyperparameters
+tuned at the base width then transfer to the scaled model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import optax
+
+from dlrover_tpu.models.transformer import TransformerConfig
+
+# Param-path fragments that are vector-like regardless of ndim (embedding
+# tables have ndim 2 but only ONE width-scaling dim).
+_VECTOR_LIKE = ("embed", "pos_embedding", "scale", "bias", "ln_")
+
+
+def is_matrix_like(path: str, ndim: int) -> bool:
+    if ndim < 2:
+        return False
+    lowered = path.lower()
+    return not any(frag in lowered for frag in _VECTOR_LIKE)
+
+
+def mup_config(
+    config: TransformerConfig, base_d_model: int
+) -> TransformerConfig:
+    """Scale a config's µP knobs relative to the tuning-width base."""
+    width_mult = config.d_model / base_d_model
+    return dataclasses.replace(config, logit_scale=1.0 / width_mult)
+
+
+def mup_scale(width_mult: float) -> optax.GradientTransformation:
+    """Chain AFTER the base optimizer: scales matrix-like updates 1/mult.
+
+    Example::
+
+        tx = optax.chain(optax.adam(lr_base), mup_scale(d_model / base_d))
+    """
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+
+        def scale(path, u):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            if is_matrix_like(name, u.ndim):
+                return u / width_mult
+            return u
+
+        return jax.tree_util.tree_map_with_path(scale, updates), state
+
+    return optax.GradientTransformation(init, update)

@@ -73,6 +73,14 @@ def make_optimizer(
         opt = optax.sgd(schedule, momentum=0.9)
     elif name == "lion":
         opt = optax.lion(schedule, weight_decay=weight_decay)
+    elif name == "agd":
+        # Stepwise-gradient-difference preconditioning (NeurIPS'23; ref
+        # ``atorch/atorch/optimizers/agd.py``).
+        from dlrover_tpu.optimizers.agd import agd
+
+        opt = agd(
+            schedule, b1=b1, b2=b2, weight_decay=weight_decay, **kwargs
+        )
     elif name == "q8_adam":
         # 8-bit moments via the fused Pallas dequant->Adam->requant kernel
         # (ref ``atorch/atorch/optimizers/low_bit/``): ~2.5 bytes/param of

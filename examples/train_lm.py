@@ -6,10 +6,12 @@ The TPU-native counterpart of the reference's flagship example
     python -m dlrover_tpu.run --standalone -- python examples/train_lm.py \
         --steps 50 --checkpoint-dir /tmp/ckpt
 
-Demonstrates the full loop: agent rendezvous env, mesh + sharded train step,
-dynamic data sharding from the master, step reporting (speed/goodput), flash
-checkpointing every N steps, and crash-resume (restart picks up from the
-latest checkpoint and the shard stream continues where it left off).
+Demonstrates the full loop through the reusable :class:`ElasticTrainer`
+façade: agent rendezvous env, mesh + sharded train step (optionally
+``--auto-tune``d), dynamic data sharding from the master, step reporting
+(speed/goodput) + device telemetry, flash checkpointing every N steps, and
+crash-resume (restart picks up from the latest checkpoint and the shard
+stream continues where it left off).
 """
 
 from __future__ import annotations
@@ -19,15 +21,12 @@ import os
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=50)
-    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="GLOBAL batch size (constant across elasticity)")
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--layers", type=int, default=2)
@@ -44,21 +43,26 @@ def parse_args():
                    help="search mesh/remat strategy before training "
                         "(auto_accelerate equivalent)")
     p.add_argument("--optimizer", default="adamw",
-                   help="adamw | adafactor | sgd | lion | q8_adam")
+                   help="adamw | adafactor | sgd | lion | q8_adam | agd")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
+    import jax
+
     from dlrover_tpu.common.log import default_logger as logger
-    from dlrover_tpu.runtime import env as renv
-    from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
-    from dlrover_tpu.parallel import rules as lr
-    from dlrover_tpu.models.gpt2 import gpt2_config
-    from dlrover_tpu.models.transformer import TransformerLM
-    from dlrover_tpu.trainer import train_lib
-    from dlrover_tpu.data.loader import ElasticDataLoader, synthetic_lm_sample_fn
+    from dlrover_tpu.data.loader import (
+        ElasticDataLoader,
+        synthetic_lm_sample_fn,
+    )
     from dlrover_tpu.data.sharding_client import ShardingClient
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.runtime import env as renv
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
 
     renv.initialize()
     client = renv.master_client()
@@ -71,46 +75,19 @@ def main():
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
     )
-    if args.auto_tune:
-        from dlrover_tpu.auto import auto_tune
-
-        tuned = auto_tune(
-            cfg,
+    trainer = ElasticTrainer(
+        cfg,
+        TrainerConfig(
             global_batch_size=args.batch_size,
             seq_len=args.seq_len,
-            max_measure=2,
-        )
-        cfg = tuned.model_config
-        mesh = build_mesh(tuned.parallel)
-        logger.info("auto_tune picked %s", tuned.best.describe())
-    else:
-        mesh = build_mesh(ParallelConfig(data=-1))
-    model = TransformerLM(cfg)
-    opt = train_lib.make_optimizer(args.optimizer, learning_rate=1e-3)
-    train = train_lib.build_sharded_train(
-        model, opt, mesh, lr.DEFAULT_RULES,
-        global_batch_size=args.batch_size, seq_len=args.seq_len,
+            optimizer=args.optimizer,
+            learning_rate=1e-3,
+            checkpoint_dir=args.checkpoint_dir,
+            ckpt_every=args.ckpt_every,
+            auto_tune=args.auto_tune,
+        ),
+        client=client,
     )
-    state = train.init(jax.random.PRNGKey(0))
-
-    ckpt = None
-    start_step = 0
-    if args.checkpoint_dir:
-        from dlrover_tpu.checkpoint import Checkpointer, StorageType
-
-        # Agent runs the saver when launched via dlrover-tpu-run
-        # (--checkpoint-dir); otherwise run it in-process.
-        ckpt = Checkpointer(
-            args.checkpoint_dir,
-            local_saver=not renv.under_agent(),
-        )
-        step, restored = ckpt.load_checkpoint(
-            shardings=train.state_shardings, state_template=state
-        )
-        if restored is not None:
-            state = restored
-            start_step = step
-            logger.info("resumed from checkpoint at step %d", step)
 
     # Each host's loader produces its local slice of the global batch;
     # shard_batch assembles the global array from the per-process pieces.
@@ -138,56 +115,16 @@ def main():
         source=loader_source,
     )
 
-    step = start_step
-    last_saved = start_step
-    t_start = time.monotonic()
-    for batch in loader:
-        if step >= args.steps:
-            break
-        placed = train_lib.shard_batch(batch, train)
-        state, metrics = train.step(state, placed)
-        step += 1
+    def on_step(step, metrics):
         if args.fail_at_step and step == args.fail_at_step:
             if renv.restart_count() == 0:
                 logger.error("test hook: crashing at step %d", step)
                 os._exit(17)
         if args.step_sleep:
             time.sleep(args.step_sleep)
-        if step % 5 == 0 or step == args.steps:
-            loss = float(metrics["loss"])
-            logger.info("step %d loss %.4f", step, loss)
-            if client is not None:
-                client.report_step(
-                    step,
-                    tokens=args.batch_size * args.seq_len * 5,
-                    loss=loss,
-                )
-            from dlrover_tpu.agent.monitor import write_device_metrics
 
-            write_device_metrics()  # HBM telemetry for the agent monitor
-        if ckpt is not None and (
-            step % args.ckpt_every == 0 or step == args.steps
-        ):
-            from dlrover_tpu.checkpoint import StorageType
-
-            ckpt.save_checkpoint(step, state, StorageType.DISK)
-            last_saved = step
-    if ckpt is not None and last_saved < step:
-        # A restart can resume at (or past) the final step with the newest
-        # state only in the previous world's uncommitted files — the final
-        # state must still be persisted and committed under THIS world.
-        from dlrover_tpu.checkpoint import StorageType
-
-        ckpt.save_checkpoint(step, state, StorageType.DISK)
-    elapsed = time.monotonic() - t_start
-    tokens = (step - start_step) * args.batch_size * args.seq_len
-    logger.info(
-        "done: %d steps (%.1f tokens/s)", step,
-        tokens / elapsed if elapsed > 0 else 0.0,
-    )
-    if ckpt is not None:
-        ckpt.wait(timeout=120)
-        ckpt.close()
+    trainer.fit(loader, max_steps=args.steps, on_step=on_step)
+    trainer.close()
     return 0
 
 

@@ -143,7 +143,7 @@ def test_hang_remediation_breaks_world():
         round_, _, world = rdzv.get_comm_world(0)
         assert world
         master.speed_monitor.collect_global_step(5, time.time() - 100)
-        master._check_training_hang()
+        master._run_diagnosis()
         assert rdzv.world_changed(round_)
     finally:
         master.stop()

@@ -1,0 +1,85 @@
+"""Coworker multiprocess preprocessing loader (shm batch transport)."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.data.coworker import CoworkerDataLoader
+from dlrover_tpu.data.loader import (
+    ElasticDistributedSampler,
+    synthetic_lm_sample_fn,
+)
+
+
+def test_coworker_matches_inprocess_batches():
+    """Worker-process preprocessing must produce byte-identical, in-order
+    batches to calling sample_fn inline."""
+    sample_fn = synthetic_lm_sample_fn(vocab_size=97, seq_len=12, seed=3)
+    loader = CoworkerDataLoader(
+        sample_fn, batch_size=4, num_workers=2, slot_bytes=1 << 20
+    )
+    try:
+        it = iter(loader)
+        got = [next(it) for _ in range(5)]
+    finally:
+        loader.close()
+    for b, batch in enumerate(got):
+        expected = {
+            key: np.stack(
+                [sample_fn(b * 4 + i)[key] for i in range(4)]
+            )
+            for key in ("inputs", "targets")
+        }
+        for key in expected:
+            np.testing.assert_array_equal(batch[key], expected[key])
+
+
+def test_coworker_finite_sampler_drains_and_stops():
+    sampler = ElasticDistributedSampler(
+        dataset_size=24, num_replicas=1, rank=0, shuffle=False
+    )
+    sample_fn = synthetic_lm_sample_fn(vocab_size=31, seq_len=4)
+    loader = CoworkerDataLoader(
+        sample_fn, batch_size=6, num_workers=2, slot_bytes=1 << 18
+    )
+    try:
+        loader.source = sampler
+        batches = list(loader)
+    finally:
+        loader.close()
+    assert len(batches) == 4
+    # In-order delivery: first batch holds indices 0..5.
+    np.testing.assert_array_equal(
+        batches[0]["inputs"][0], sample_fn(0)["inputs"]
+    )
+
+
+def test_coworker_oversized_batch_raises_cleanly():
+    sample_fn = synthetic_lm_sample_fn(vocab_size=31, seq_len=4096)
+    loader = CoworkerDataLoader(
+        sample_fn, batch_size=64, num_workers=1, slot_bytes=1 << 12
+    )
+    try:
+        with pytest.raises(RuntimeError, match="coworker"):
+            next(iter(loader))
+    finally:
+        loader.close()
+
+
+def test_coworker_sample_error_surfaces_with_surviving_workers():
+    """One worker hitting a bad sample must raise promptly even while other
+    workers stay alive (a lost seq would stall in-order delivery)."""
+
+    def flaky(index):
+        if index == 5:
+            raise ValueError("bad record")
+        return {"x": np.full((4,), index, np.int32)}
+
+    loader = CoworkerDataLoader(
+        flaky, batch_size=2, num_workers=2, slot_bytes=1 << 16
+    )
+    try:
+        with pytest.raises(RuntimeError, match="coworker"):
+            for _ in iter(loader):
+                pass
+    finally:
+        loader.close()

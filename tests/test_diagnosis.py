@@ -1,0 +1,119 @@
+"""Diagnosis inference chain + master state persistence."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.master import messages as msg
+from dlrover_tpu.master.diagnosis import (
+    ActionType,
+    DiagnosisContext,
+    DiagnosisManager,
+    InferenceChain,
+    NodeFlappingOperator,
+    ResourceStallOperator,
+    TrainingHangOperator,
+)
+from dlrover_tpu.master.job_master import JobMaster
+from dlrover_tpu.master.metrics import MetricsCollector
+from dlrover_tpu.master.node_manager import NodeManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+
+def _ctx(**kw):
+    defaults = dict(
+        speed_monitor=SpeedMonitor(),
+        metrics=MetricsCollector(),
+        node_manager=NodeManager(num_nodes=2),
+        hang_threshold=1.0,
+    )
+    defaults.update(kw)
+    return DiagnosisContext(**defaults)
+
+
+def test_hang_operator_fires_only_after_threshold():
+    ctx = _ctx()
+    op = TrainingHangOperator()
+    assert op.observe(ctx) == []  # step 0: still initializing
+    ctx.speed_monitor.collect_global_step(3, time.time() - 50)
+    actions = op.observe(ctx)
+    assert actions and actions[0].action == ActionType.RESTART_WORLD
+    ctx.speed_monitor.collect_global_step(4, time.time())
+    assert op.observe(ctx) == []
+
+
+def test_resource_stall_and_flapping_report():
+    ctx = _ctx(resource_stale_s=10.0)
+    ctx.metrics.collect(0, 10.0, 1.0, timestamp=time.time() - 100)
+    ctx.node_manager._nodes[1].relaunch_count = 2  # budget 3 -> suspect
+    actions = InferenceChain(
+        [ResourceStallOperator(), NodeFlappingOperator()]
+    ).infer(ctx)
+    kinds = {(a.action, a.node_id) for a in actions}
+    assert (ActionType.REPORT, 0) in kinds
+    assert (ActionType.REPORT, 1) in kinds
+
+
+def test_manager_cooldown_gates_remediation():
+    mgr = DiagnosisManager(cooldown_s=60.0)
+    ctx = _ctx()
+    ctx.speed_monitor.collect_global_step(3, time.time() - 50)
+    first = mgr.run(ctx)
+    assert [a.action for a in first] == [ActionType.RESTART_WORLD]
+    second = mgr.run(ctx)  # still hung, but inside cooldown
+    assert second == []
+
+
+def test_master_state_roundtrip(tmp_path):
+    path = str(tmp_path / "master_state.json")
+    master = JobMaster(num_nodes=2, min_nodes=1, state_path=path)
+    try:
+        rdzv = master.rdzv_managers["elastic-training"]
+        for rank in (0, 1):
+            rdzv.join_rendezvous(rank, 1)
+        rdzv.update_rdzv_params(2, 2, waiting_timeout=0.1)
+        rdzv.get_comm_world(0)  # seals round 1
+        master.task_manager.create_dataset(
+            msg.DatasetShardParams(
+                dataset_name="d", dataset_size=40, shard_size=10
+            )
+        )
+        task = master.task_manager.get_task("d", node_id=0)
+        master.task_manager.report_task("d", task.task_id, success=True)
+        master.node_manager.ensure_node(1).relaunch_count = 2
+        master.kv_store.put("coord", b"host:1234")
+        master.speed_monitor.collect_global_step(17, time.time())
+        master._state_store.save(master)
+    finally:
+        master.stop()
+
+    fresh = JobMaster(num_nodes=2, min_nodes=1, state_path=path)
+    try:
+        fresh.start()
+        # Round counter stays monotonic; world itself is re-formed by agents.
+        assert fresh.rdzv_managers["elastic-training"]._rdzv_round >= 1
+        # Shard progress survives: 4 shards total, 1 completed -> 3 remain.
+        remaining = 0
+        while True:
+            t = fresh.task_manager.get_task("d", node_id=0)
+            if t.empty:
+                break
+            remaining += 1
+            fresh.task_manager.report_task("d", t.task_id, success=True)
+        assert remaining == 3
+        assert fresh.node_manager.ensure_node(1).relaunch_count == 2
+        assert fresh.kv_store.get("coord") == b"host:1234"
+        assert fresh.speed_monitor.global_step == 17
+    finally:
+        fresh.stop()
+
+
+def test_master_restart_without_state_file_is_fresh(tmp_path):
+    master = JobMaster(
+        num_nodes=1, state_path=str(tmp_path / "none.json")
+    )
+    try:
+        master.start()
+        assert master.speed_monitor.global_step == 0
+    finally:
+        master.stop()

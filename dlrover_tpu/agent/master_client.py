@@ -197,6 +197,22 @@ class MasterClient:
     def get_job_status(self) -> msg.JobStatus:
         return self.get(msg.JobStatusRequest()).payload
 
+    def join_sync(self, name: str, need: int) -> bool:
+        return bool(self.get(msg.SyncJoin(name, self.node_id, need)).payload)
+
+    def sync_finished(self, name: str) -> bool:
+        return bool(self.get(msg.SyncQuery(name)).payload)
+
+    def report_cluster_version(self, version: int, expected: int = 0) -> int:
+        return int(
+            self.get(
+                msg.ClusterVersion(self.node_id, version, expected)
+            ).payload
+        )
+
+    def get_cluster_version(self) -> int:
+        return int(self.get(msg.ClusterVersion(self.node_id, -1)).payload)
+
     def get_paral_config(self) -> msg.ParalConfig:
         return self.get(msg.ParalConfigRequest(self.node_id)).payload
 

@@ -170,6 +170,7 @@ class ElasticAgent:
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._resource_monitor = None
+        self._paral_config_version = 0
 
     def _metrics_file(self) -> str:
         """Trainer->agent device-telemetry handoff file (ref
@@ -178,6 +179,41 @@ class ElasticAgent:
 
         os.makedirs(socket_dir(), exist_ok=True)
         return os.path.join(socket_dir(), f"metrics_n{self.node_id}.json")
+
+    def _paral_config_file(self) -> str:
+        """Master->trainer runtime-tunable-config handoff file (ref
+        ``elastic_agent/config/paral_config_tuner.py:30-78``)."""
+        from dlrover_tpu.common.multi_process import socket_dir
+
+        os.makedirs(socket_dir(), exist_ok=True)
+        return os.path.join(
+            socket_dir(), f"paral_config_n{self.node_id}.json"
+        )
+
+    def _poll_paral_config(self):
+        """Fetch the master's runtime config; rewrite the trainer-visible
+        file only when the version advances."""
+        import dataclasses as _dc
+        import json
+
+        try:
+            config = self.client.get_paral_config()
+        except ConnectionError:
+            return
+        except Exception as e:  # noqa: BLE001 - config must not kill agent
+            logger.warning("paral config poll failed: %s", e)
+            return
+        if config is None or config.version == self._paral_config_version:
+            return
+        self._paral_config_version = config.version
+        path = self._paral_config_file()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_dc.asdict(config), f)
+        os.replace(tmp, path)
+        logger.info(
+            "paral config v%d written for trainer", config.version
+        )
 
     # -- worker lifecycle -----------------------------------------------------
 
@@ -194,6 +230,7 @@ class ElasticAgent:
                 ENV_PROC_ID: str(rdzv["rank"]),
                 ENV_RESTART_COUNT: str(self._restart_count),
                 ConfigKey.METRICS_FILE: self._metrics_file(),
+                ConfigKey.PARAL_CONFIG_PATH: self._paral_config_file(),
             }
         )
         logger.info(
@@ -268,6 +305,7 @@ class ElasticAgent:
                 self.client.report_heartbeat()
             except ConnectionError:
                 logger.warning("heartbeat: master unreachable")
+            self._poll_paral_config()
             self._stop.wait(self.config.heartbeat_interval)
 
     # -- main loop ------------------------------------------------------------

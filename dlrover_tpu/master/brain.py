@@ -1,0 +1,158 @@
+"""Brain-lite: job-history store + resource optimization service.
+
+Capability ref: the reference's Brain tier — ``dlrover/go/brain/``
+(optimize() RPCs over a MySQL job-metrics store; algorithms in
+``pkg/optimizer/implementation/*``), its python client
+(``dlrover/python/brain/client.py``) and the master-local fallback
+(``master/resource/local_optimizer.py:66-397``).
+
+TPU redesign: the persistent tier is a JSON history file (one record per
+completed job: model scale, mesh, throughput, goodput) instead of MySQL,
+and ``optimize()`` recommends a ResourcePlan for a new job from the most
+similar past runs — the same observe-and-recommend loop at laptop scale.
+The JobMaster records its own run on stop; the auto-scaler's ``set_target``
+is the actuation path for a recommendation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass
+class JobRecord:
+    job_name: str
+    model_params: int            # parameter count (scale proxy)
+    num_nodes: int
+    global_batch_size: int
+    tokens_per_sec: float = 0.0
+    goodput: float = 0.0
+    completed: bool = True
+    timestamp: float = 0.0
+
+
+@dataclasses.dataclass
+class ResourcePlan:
+    """What the optimizer recommends (slice-granular node count + batch)."""
+
+    num_nodes: int
+    global_batch_size: int
+    reason: str = ""
+    confidence: float = 0.0
+
+
+class BrainService:
+    """History store + recommendation algorithms (local file backend)."""
+
+    def __init__(self, history_path: str):
+        self.history_path = history_path
+        self._records: List[JobRecord] = []
+        self._load()
+
+    def _load(self):
+        if not os.path.exists(self.history_path):
+            return
+        try:
+            with open(self.history_path) as f:
+                raw = json.load(f)
+            self._records = [JobRecord(**r) for r in raw]
+        except (OSError, ValueError, TypeError) as e:
+            logger.warning("brain history unreadable (%s); starting empty", e)
+
+    def persist_metrics(self, record: JobRecord):
+        """The Brain.persist_metrics() equivalent."""
+        record.timestamp = record.timestamp or time.time()
+        self._records.append(record)
+        tmp = self.history_path + ".tmp"
+        os.makedirs(os.path.dirname(self.history_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(
+                [dataclasses.asdict(r) for r in self._records[-1000:]], f
+            )
+        os.replace(tmp, self.history_path)
+
+    def get_job_metrics(self, job_name: str) -> List[JobRecord]:
+        return [r for r in self._records if r.job_name == job_name]
+
+    def optimize(
+        self,
+        model_params: int,
+        max_nodes: int,
+        min_nodes: int = 1,
+        default_batch: int = 8,
+    ) -> ResourcePlan:
+        """Recommend node count + batch from the most similar past runs.
+
+        Similarity = log-scale closeness of parameter count; among similar
+        runs, pick the configuration with the best goodput-weighted
+        throughput per node (the reference's job-resource optimizer
+        objective: utilization, not raw speed).
+        """
+        def distance(r: JobRecord) -> float:
+            return abs(
+                math.log10(max(r.model_params, 1))
+                - math.log10(max(model_params, 1))
+            )
+
+        # Only genuinely comparable runs may drive the plan: within one
+        # order of magnitude in parameter count.  A toy run must not size a
+        # billion-parameter job.
+        candidates = [
+            r for r in self._records
+            if r.completed and r.tokens_per_sec > 0 and distance(r) <= 1.0
+        ]
+        if not candidates:
+            return ResourcePlan(
+                num_nodes=max_nodes,
+                global_batch_size=default_batch,
+                reason="no comparable history; defaulting to max_nodes",
+                confidence=0.0,
+            )
+        similar = sorted(candidates, key=distance)[:8]
+
+        def score(r: JobRecord) -> float:
+            per_node = r.tokens_per_sec / max(r.num_nodes, 1)
+            return per_node * max(r.goodput, 0.5)
+
+        best = max(similar, key=score)
+        nodes = max(min_nodes, min(max_nodes, best.num_nodes))
+        return ResourcePlan(
+            num_nodes=nodes,
+            global_batch_size=best.global_batch_size or default_batch,
+            reason=(
+                f"best of {len(similar)} similar runs: "
+                f"{best.job_name} ({best.tokens_per_sec:.0f} tok/s on "
+                f"{best.num_nodes} nodes, goodput {best.goodput:.2f})"
+            ),
+            confidence=min(1.0, len(similar) / 4.0),
+        )
+
+
+def record_job(
+    brain: BrainService,
+    job_name: str,
+    speed_monitor,
+    num_nodes: int,
+    model_params: int = 0,
+    global_batch_size: int = 0,
+    completed: bool = True,
+):
+    """Convenience hook for the master's shutdown path."""
+    brain.persist_metrics(
+        JobRecord(
+            job_name=job_name,
+            model_params=model_params,
+            num_nodes=num_nodes,
+            global_batch_size=global_batch_size,
+            tokens_per_sec=speed_monitor.token_throughput(),
+            goodput=speed_monitor.goodput(),
+            completed=completed,
+        )
+    )

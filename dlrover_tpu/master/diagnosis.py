@@ -113,15 +113,16 @@ class NodeFlappingOperator(InferenceOperator):
 
     def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
         out = []
-        for node_id, state in getattr(ctx.node_manager, "_nodes", {}).items():
-            if state.relaunch_count >= max(1, state.max_relaunches - 1):
+        for node_id, state in ctx.node_manager.snapshot().items():
+            budget = state["max_relaunches"]
+            if state["relaunch_count"] >= max(1, budget - 1):
                 out.append(
                     DiagnosisAction(
                         ActionType.REPORT,
                         reason=(
                             f"node {node_id} relaunched "
-                            f"{state.relaunch_count}x (budget "
-                            f"{state.max_relaunches}) — suspect hardware"
+                            f"{state['relaunch_count']}x (budget "
+                            f"{budget}) — suspect hardware"
                         ),
                         node_id=node_id,
                         severity=1,
@@ -188,6 +189,10 @@ class DiagnosisManager:
         actions = self.chain.infer(ctx)
         to_execute = []
         now = time.monotonic()
+        # One cooldown gate per TICK, not per action: a tick prescribing
+        # both a node relaunch and a world restart must execute both (the
+        # relaunch alone would no-op the hang it was paired with).
+        may_remediate = now - self._last_remediation >= self.cooldown_s
         for action in actions:
             if action.action == ActionType.REPORT:
                 key = (action.node_id, action.reason)
@@ -199,7 +204,7 @@ class DiagnosisManager:
                 self.reports.append(action)
                 self.reports = self.reports[-100:]
                 logger.warning("diagnosis: %s", action.reason)
-            elif now - self._last_remediation >= self.cooldown_s:
+            elif may_remediate:
                 self._last_remediation = now
                 to_execute.append(action)
         return to_execute

@@ -159,7 +159,10 @@ class JobMaster:
                     manager.invalidate_world()
                 self.speed_monitor.reset_running_speed()
             elif action.action == ActionType.RELAUNCH_NODE:
-                self.node_manager.launch_node(action.node_id)
+                # The target still heartbeats (it is wedged, not dead):
+                # force teardown + relaunch, not the repair-path launch that
+                # no-ops on RUNNING nodes.
+                self.node_manager.force_relaunch(action.node_id)
 
     def _handle_node_death(self, node_id: int):
         """Silent host death (heartbeat timeout) gets the same recovery as a
@@ -169,6 +172,7 @@ class JobMaster:
         logger.warning("node %d declared dead (heartbeat timeout)", node_id)
         for manager in self.rdzv_managers.values():
             manager.remove_alive_node(node_id)
+        self.servicer.sync_service.remove_node(node_id)
         self.task_manager.recover_tasks(node_id)
         self.speed_monitor.reset_running_speed()
         if self.auto_scaler is None:

@@ -220,6 +220,30 @@ class JobStatus:
 
 
 @dataclasses.dataclass
+class SyncJoin:
+    """Named worker barrier (ref sync_service.py); returns completion."""
+
+    name: str
+    node_id: int
+    need: int
+
+
+@dataclasses.dataclass
+class SyncQuery:
+    name: str
+
+
+@dataclasses.dataclass
+class ClusterVersion:
+    """PS cluster-version protocol (ref elastic_ps.py): report local,
+    receive global."""
+
+    node_id: int
+    version: int = -1  # -1 = query only
+    expected: int = 0  # reporters required before the global can advance
+
+
+@dataclasses.dataclass
 class ParalConfigRequest:
     node_id: int
 

@@ -286,15 +286,40 @@ class NodeManager:
             return {i: n.status.value for i, n in self._nodes.items()}
 
     def snapshot(self) -> Dict[int, Dict]:
-        """Consistent inventory copy for persistence."""
+        """Consistent inventory copy for persistence/diagnosis readers."""
         with self._lock:
             return {
                 i: {
                     "status": n.status.value,
                     "relaunch_count": n.relaunch_count,
+                    "max_relaunches": n.max_relaunches,
                 }
                 for i, n in self._nodes.items()
             }
+
+    def force_relaunch(self, node_id: int) -> bool:
+        """Diagnosis-driven relaunch: tear the host down and relaunch even
+        when it still looks RUNNING (wedged-below-the-agent remediation).
+        Budget-limited like every other relaunch path."""
+        with self._lock:
+            node = self.ensure_node(node_id)
+            if node.relaunch_count >= node.max_relaunches:
+                logger.warning(
+                    "node %d relaunch budget exhausted (force)", node_id
+                )
+                return False
+            node.relaunch_count += 1
+            node.last_heartbeat = time.time()
+            self._transition(node, NodeStatus.PENDING)
+        self._launcher.delete(node_id)
+        try:
+            self._launcher.launch(node_id)
+        except Exception as e:  # noqa: BLE001 - cloud APIs fail transiently
+            logger.error("force relaunch of node %d failed: %s", node_id, e)
+            with self._lock:
+                self._transition(self.ensure_node(node_id), NodeStatus.DEAD)
+            return False
+        return True
 
     def all_succeeded(self) -> bool:
         with self._lock:

@@ -44,6 +44,9 @@ class MasterServicer:
         self.kv_store = kv_store
         self.paral_config = paral_config or msg.ParalConfig()
         self.metrics = metrics
+        from dlrover_tpu.master.sync_service import SyncService
+
+        self.sync_service = SyncService()
         self._get_handlers: Dict[Type, Callable] = {
             msg.CommWorldRequest: self._get_comm_world,
             msg.WaitingNodesRequest: self._get_waiting_nodes,
@@ -55,6 +58,9 @@ class MasterServicer:
             msg.JobStatusRequest: self._get_job_status,
             msg.ParalConfigRequest: self._get_paral_config,
             msg.NetworkCheckResultRequest: self._get_network_check_result,
+            msg.SyncJoin: self._join_sync,
+            msg.SyncQuery: self._query_sync,
+            msg.ClusterVersion: self._cluster_version,
         }
         self._report_handlers: Dict[Type, Callable] = {
             msg.JoinRendezvous: self._join_rendezvous,
@@ -221,6 +227,30 @@ class MasterServicer:
 
     def _get_paral_config(self, env: msg.Envelope):
         return self.paral_config
+
+    def update_paral_config(self, config: msg.ParalConfig):
+        """Master-side tuners (auto-scaler/brain tier) push new runtime
+        knobs; agents poll and hand them to trainers via the config file
+        (ref ``paral_config_tuner.py:30-78``)."""
+        config.version = self.paral_config.version + 1
+        self.paral_config = config
+
+    # -- sync service ---------------------------------------------------------
+
+    def _join_sync(self, env: msg.Envelope):
+        p: msg.SyncJoin = env.payload
+        return self.sync_service.join_sync(p.name, p.node_id, p.need)
+
+    def _query_sync(self, env: msg.Envelope):
+        return self.sync_service.sync_finished(env.payload.name)
+
+    def _cluster_version(self, env: msg.Envelope):
+        p: msg.ClusterVersion = env.payload
+        if p.version >= 0:
+            return self.sync_service.update_local_version(
+                p.node_id, p.version, p.expected
+            )
+        return self.sync_service.get_global_version()
 
 
 class _GenericHandler(grpc.GenericRpcHandler):

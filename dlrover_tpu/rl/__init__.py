@@ -1,0 +1,1 @@
+from dlrover_tpu.rl.ppo import PPOConfig, PPOTrainer  # noqa: F401

@@ -1,0 +1,302 @@
+"""PPO-based RLHF trainer: actor / critic / frozen reference, one mesh.
+
+Capability ref: ``atorch/atorch/rl/`` (~3.3k LoC:
+``trainer/ppo_trainer.py`` PPO loop, ``model_engine/model_engine.py``
+multi-model orchestration of actor/critic/ref/reward across devices,
+``replay_buffer/``).
+
+TPU redesign: the reference shuttles four torch models between GPUs and a
+DeepSpeed hybrid engine; under SPMD all four live as param pytrees on one
+mesh and every phase is a pure jitted function —
+
+* rollout: autoregressive sampling from the actor (full re-forward per
+  token; a KV-cache decode path slots in behind the same signature),
+* scoring: per-token logprobs under actor and frozen reference, values
+  from the critic, task reward from a user ``reward_fn``,
+* learning: GAE advantages, clipped PPO surrogate + value clip + entropy
+  bonus, with a per-token KL penalty against the reference policy folded
+  into the reward (the standard RLHF shaping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+class CriticModel(nn.Module):
+    """Value model: the LM trunk with a scalar head over hidden states."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        hidden, _ = TransformerLM(self.config, name="trunk")(
+            tokens, return_hidden=True
+        )
+        values = nn.Dense(1, name="value_head")(
+            hidden.astype(jnp.float32)
+        )
+        return values[..., 0]  # [B, S]
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    rollout_len: int = 16
+    temperature: float = 1.0
+    kl_coef: float = 0.1
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    gamma: float = 1.0
+    gae_lambda: float = 0.95
+    ppo_epochs: int = 2
+    learning_rate: float = 1e-4
+
+
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-prob of tokens[t] under logits[t-1] -> [B, S-1]."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        logp, tokens[:, 1:, None], axis=-1
+    )[..., 0]
+
+
+def gae_advantages(
+    rewards: jax.Array, values: jax.Array, gamma: float, lam: float
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over the response region.
+
+    ``rewards``/``values``: [B, T] aligned per generated token; terminal
+    bootstrap value 0.
+    """
+    def scan_fn(carry, inp):
+        reward, value, next_value = inp
+        delta = reward + gamma * next_value - value
+        adv = delta + gamma * lam * carry
+        return adv, adv
+
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1
+    )
+    _, advs = jax.lax.scan(
+        scan_fn,
+        jnp.zeros(rewards.shape[0]),
+        (rewards.T, values.T, next_values.T),
+        reverse=True,
+    )
+    advantages = advs.T
+    returns = advantages + values
+    return advantages, returns
+
+
+class PPOTrainer:
+    def __init__(
+        self,
+        model_config: TransformerConfig,
+        reward_fn: Callable[[np.ndarray], np.ndarray],
+        config: PPOConfig = PPOConfig(),
+        rng: Optional[jax.Array] = None,
+    ):
+        self.config = config
+        self.model_config = model_config
+        self.reward_fn = reward_fn
+        self.actor = TransformerLM(model_config)
+        self.critic = CriticModel(model_config)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        k1, k2, self._rng = jax.random.split(rng, 3)
+        dummy = jnp.zeros((1, model_config.max_seq_len), jnp.int32)
+        self.actor_params = nn.meta.unbox(
+            self.actor.init(k1, dummy)["params"]
+        )
+        self.ref_params = self.actor_params  # frozen snapshot
+        self.critic_params = nn.meta.unbox(
+            self.critic.init(k2, dummy)["params"]
+        )
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adam(config.learning_rate),
+        )
+        self.opt_state = self.tx.init(
+            {"actor": self.actor_params, "critic": self.critic_params}
+        )
+        self._sample_step = jax.jit(self._sample_one)
+        self._update = jax.jit(self._ppo_update)
+
+    # -- rollout --------------------------------------------------------------
+
+    def _sample_one(self, params, tokens, length, rng):
+        logits, _ = self.actor.apply({"params": params}, tokens)
+        # Next-token distribution at the current length (static shapes: the
+        # buffer is full-width; `length` indexes the frontier).
+        last = jnp.take_along_axis(
+            logits, (length - 1)[:, None, None], axis=1
+        )[:, 0]
+        scaled = last / jnp.maximum(self.config.temperature, 1e-6)
+        return jax.random.categorical(rng, scaled, axis=-1)
+
+    def rollout(self, prompts: np.ndarray) -> Dict[str, np.ndarray]:
+        """Sample ``rollout_len`` tokens after each prompt (right-padded
+        static buffer)."""
+        batch, prompt_len = prompts.shape
+        total = prompt_len + self.config.rollout_len
+        if total > self.model_config.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt_len} + rollout {self.config.rollout_len} "
+                f"exceeds max_seq_len {self.model_config.max_seq_len}"
+            )
+        tokens = np.zeros((batch, total), np.int32)
+        tokens[:, :prompt_len] = prompts
+        length = np.full((batch,), prompt_len, np.int32)
+        for _ in range(self.config.rollout_len):
+            self._rng, step_rng = jax.random.split(self._rng)
+            nxt = np.asarray(
+                self._sample_step(
+                    self.actor_params, jnp.asarray(tokens),
+                    jnp.asarray(length), step_rng,
+                )
+            )
+            tokens[np.arange(batch), length] = nxt
+            length += 1
+        return {"tokens": tokens, "prompt_len": prompt_len}
+
+    # -- learning -------------------------------------------------------------
+
+    def _ppo_update(self, params, opt_state, batch):
+        cfg = self.config
+        tokens = batch["tokens"]
+        resp_mask = batch["resp_mask"]          # [B, S-1] response region
+        old_logp = batch["old_logp"]
+        old_values = batch["old_values"]
+        advantages = batch["advantages"]
+        returns = batch["returns"]
+
+        def loss_fn(params):
+            logits, _ = self.actor.apply(
+                {"params": params["actor"]}, tokens
+            )
+            logp = token_logprobs(logits, tokens)
+            ratio = jnp.exp((logp - old_logp) * resp_mask)
+            unclipped = ratio * advantages
+            clipped = jnp.clip(
+                ratio, 1 - cfg.clip_ratio, 1 + cfg.clip_ratio
+            ) * advantages
+            denom = jnp.maximum(resp_mask.sum(), 1.0)
+            pg_loss = -jnp.sum(
+                jnp.minimum(unclipped, clipped) * resp_mask
+            ) / denom
+
+            values = self.critic.apply(
+                {"params": params["critic"]}, tokens
+            )[:, :-1]
+            v_clipped = old_values + jnp.clip(
+                values - old_values, -cfg.value_clip, cfg.value_clip
+            )
+            v_loss = 0.5 * jnp.sum(
+                jnp.maximum(
+                    (values - returns) ** 2, (v_clipped - returns) ** 2
+                ) * resp_mask
+            ) / denom
+
+            probs = jax.nn.softmax(
+                logits[:, :-1].astype(jnp.float32), axis=-1
+            )
+            entropy = -jnp.sum(
+                probs * jnp.log(probs + 1e-9), axis=-1
+            )
+            ent_bonus = jnp.sum(entropy * resp_mask) / denom
+
+            total = (
+                pg_loss
+                + cfg.vf_coef * v_loss
+                - cfg.entropy_coef * ent_bonus
+            )
+            return total, (pg_loss, v_loss, ent_bonus)
+
+        (loss, (pg, vf, ent)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {"loss": loss, "pg_loss": pg, "v_loss": vf, "entropy": ent}
+        return params, opt_state, metrics
+
+    def step(self, prompts: np.ndarray) -> Dict[str, float]:
+        """One PPO iteration: rollout -> score -> ppo_epochs updates."""
+        cfg = self.config
+        roll = self.rollout(prompts)
+        tokens = jnp.asarray(roll["tokens"])
+        prompt_len = roll["prompt_len"]
+
+        actor_logits, _ = self.actor.apply(
+            {"params": self.actor_params}, tokens
+        )
+        ref_logits, _ = self.actor.apply(
+            {"params": self.ref_params}, tokens
+        )
+        logp = token_logprobs(actor_logits, tokens)
+        ref_logp = token_logprobs(ref_logits, tokens)
+        values = self.critic.apply(
+            {"params": self.critic_params}, tokens
+        )[:, :-1]
+
+        resp_mask = np.zeros(logp.shape, np.float32)
+        resp_mask[:, prompt_len - 1:] = 1.0
+        resp_mask = jnp.asarray(resp_mask)
+
+        # Reward shaping: task reward on the final token + per-token KL
+        # penalty against the frozen reference.
+        task_reward = np.asarray(
+            self.reward_fn(roll["tokens"]), np.float32
+        )
+        kl = (logp - ref_logp) * resp_mask
+        rewards = -cfg.kl_coef * kl
+        rewards = rewards.at[:, -1].add(jnp.asarray(task_reward))
+
+        advantages, returns = gae_advantages(
+            rewards, values, cfg.gamma, cfg.gae_lambda
+        )
+        # Normalization statistics over the RESPONSE region only — prompt
+        # positions carry critic noise that would rescale the advantages
+        # the masked pg_loss actually uses.
+        denom = jnp.maximum(resp_mask.sum(), 1.0)
+        masked_mean = (advantages * resp_mask).sum() / denom
+        masked_var = (
+            ((advantages - masked_mean) ** 2) * resp_mask
+        ).sum() / denom
+        advantages = (advantages - masked_mean) / (
+            jnp.sqrt(masked_var) + 1e-8
+        )
+
+        batch = {
+            "tokens": tokens,
+            "resp_mask": resp_mask,
+            "old_logp": logp,
+            "old_values": values,
+            "advantages": jax.lax.stop_gradient(advantages),
+            "returns": jax.lax.stop_gradient(returns),
+        }
+        params = {"actor": self.actor_params, "critic": self.critic_params}
+        metrics = {}
+        for _ in range(cfg.ppo_epochs):
+            params, self.opt_state, metrics = self._update(
+                params, self.opt_state, batch
+            )
+        self.actor_params = params["actor"]
+        self.critic_params = params["critic"]
+        out = {k: float(v) for k, v in metrics.items()}
+        out["mean_task_reward"] = float(task_reward.mean())
+        out["mean_kl"] = float(
+            (kl.sum() / jnp.maximum(resp_mask.sum(), 1.0))
+        )
+        return out

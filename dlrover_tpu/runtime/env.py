@@ -67,6 +67,23 @@ def initialize(force: bool = False):
     )
 
 
+def read_paral_config() -> Optional[dict]:
+    """Latest runtime-tunable config the agent fetched from the master
+    (ref ``ParalConfigTuner``); None when absent/unset."""
+    import json
+
+    from dlrover_tpu.common.constants import ConfigKey
+
+    path = os.environ.get(ConfigKey.PARAL_CONFIG_PATH)
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def master_client(node_type: str = "worker"):
     """The trainer's MasterClient, or None when running without a master."""
     addr = os.environ.get(ENV_MASTER_ADDR, "")

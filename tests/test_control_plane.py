@@ -200,3 +200,36 @@ def test_network_check_odd_healthy_pool_no_singleton():
     covered = sorted(r for g in groups for r in g)
     assert covered == list(range(5))
     assert all(len(g) >= 2 for g in groups)
+
+
+def test_sync_service_barrier_and_cluster_version(master, client):
+    client2 = MasterClient(f"localhost:{master.port}", node_id=1)
+    assert client.join_sync("init", need=2) is False
+    assert client2.join_sync("init", need=2) is True
+    # Late (re-)join of a finished barrier passes immediately.
+    assert client.join_sync("init", need=2) is True
+    assert client.sync_finished("init")
+
+    # Cluster version: global = min over reporters, gated on the expected
+    # reporter count (one early reporter must not advance it alone).
+    assert client.report_cluster_version(3, expected=2) == 0
+    assert client2.report_cluster_version(2, expected=2) == 2
+    assert client.get_cluster_version() == 2
+    # A dead node must not hold the version back or wedge barriers.
+    client.join_sync("resize", need=2)
+    master._handle_node_death(1)
+    assert client.sync_finished("resize")
+    assert client.report_cluster_version(3, expected=1) == 3
+    client2.close()
+
+
+def test_paral_config_update_and_versioning(master, client):
+    from dlrover_tpu.master import messages as msg
+
+    base = client.get_paral_config()
+    master.servicer.update_paral_config(
+        msg.ParalConfig(global_batch_size=64, grad_accum=2)
+    )
+    updated = client.get_paral_config()
+    assert updated.version == base.version + 1
+    assert updated.global_batch_size == 64

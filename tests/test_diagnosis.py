@@ -117,3 +117,35 @@ def test_master_restart_without_state_file_is_fresh(tmp_path):
         assert master.speed_monitor.global_step == 0
     finally:
         master.stop()
+
+
+def test_brain_optimize_from_history(tmp_path):
+    from dlrover_tpu.master.brain import BrainService, JobRecord
+
+    path = str(tmp_path / "brain.json")
+    brain = BrainService(path)
+    # No history: conservative default.
+    plan = brain.optimize(model_params=10**9, max_nodes=8)
+    assert plan.num_nodes == 8 and plan.confidence == 0.0
+
+    brain.persist_metrics(JobRecord(
+        "gpt1b-a", model_params=10**9, num_nodes=8,
+        global_batch_size=64, tokens_per_sec=8000, goodput=0.6,
+    ))
+    brain.persist_metrics(JobRecord(
+        "gpt1b-b", model_params=10**9, num_nodes=4,
+        global_batch_size=32, tokens_per_sec=6000, goodput=0.95,
+    ))
+    brain.persist_metrics(JobRecord(
+        "tiny", model_params=10**6, num_nodes=1,
+        global_batch_size=8, tokens_per_sec=100, goodput=0.99,
+    ))
+    plan = brain.optimize(model_params=1.2 * 10**9, max_nodes=8)
+    # 4 nodes wins: higher goodput-weighted throughput per node.
+    assert plan.num_nodes == 4
+    assert plan.global_batch_size == 32
+    assert plan.confidence > 0
+
+    # History survives a restart (the MySQL-equivalent durability).
+    fresh = BrainService(path)
+    assert len(fresh.get_job_metrics("gpt1b-a")) == 1

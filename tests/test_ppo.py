@@ -1,0 +1,75 @@
+"""PPO RLHF trainer: rollout shapes, GAE math, reward improvement."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.rl.ppo import PPOConfig, PPOTrainer, gae_advantages
+
+
+def tiny_cfg():
+    return gpt2_config(
+        "124m", num_layers=1, d_model=32, num_heads=2,
+        vocab_size=32, max_seq_len=24,
+    )
+
+
+def test_gae_matches_hand_computation():
+    rewards = jnp.asarray([[0.0, 0.0, 1.0]])
+    values = jnp.asarray([[0.1, 0.2, 0.3]])
+    adv, ret = gae_advantages(rewards, values, gamma=1.0, lam=1.0)
+    # With gamma=lam=1 and terminal bootstrap 0: adv_t = sum(r_t:) - v_t.
+    np.testing.assert_allclose(adv[0], [0.9, 0.8, 0.7], atol=1e-6)
+    np.testing.assert_allclose(ret[0], [1.0, 1.0, 1.0], atol=1e-6)
+
+
+def test_rollout_fills_response_region():
+    trainer = PPOTrainer(
+        tiny_cfg(),
+        reward_fn=lambda toks: np.zeros(toks.shape[0]),
+        config=PPOConfig(rollout_len=6),
+    )
+    prompts = np.ones((3, 4), np.int32)
+    roll = trainer.rollout(prompts)
+    assert roll["tokens"].shape == (3, 10)
+    np.testing.assert_array_equal(roll["tokens"][:, :4], 1)
+    assert (roll["tokens"][:, 4:] < 32).all()
+
+
+def test_ppo_increases_task_reward():
+    """Reward = frequency of token 7 in the response; PPO must learn to
+    emit it (the classic token-bandit sanity check)."""
+    target = 7
+
+    def reward_fn(tokens):
+        resp = tokens[:, 4:]
+        return (resp == target).mean(axis=1).astype(np.float32) * 4.0
+
+    trainer = PPOTrainer(
+        tiny_cfg(),
+        reward_fn,
+        config=PPOConfig(
+            rollout_len=8, kl_coef=0.01, learning_rate=3e-3,
+            ppo_epochs=2, entropy_coef=0.0, temperature=1.0,
+        ),
+    )
+    prompts = np.ones((16, 4), np.int32)
+    rewards = [trainer.step(prompts)["mean_task_reward"] for _ in range(12)]
+    early = np.mean(rewards[:3])
+    late = np.mean(rewards[-3:])
+    assert late > early + 0.3, f"no learning: {rewards}"
+
+
+def test_kl_penalty_tracks_divergence():
+    trainer = PPOTrainer(
+        tiny_cfg(),
+        reward_fn=lambda toks: np.ones(toks.shape[0]),
+        config=PPOConfig(rollout_len=4, kl_coef=0.5, learning_rate=5e-3),
+    )
+    prompts = np.ones((4, 4), np.int32)
+    first = trainer.step(prompts)
+    assert abs(first["mean_kl"]) < 1e-4  # actor == reference at start
+    for _ in range(4):
+        metrics = trainer.step(prompts)
+    assert np.isfinite(metrics["loss"])

@@ -85,6 +85,10 @@ class AsyncCheckpointSaver:
         self._stopped = threading.Event()
         self._persisted_step = -1
         self._cleaned_steps: set = set()
+        # Bumped by set_world: in-flight commit barriers for a superseded
+        # world must abort instead of blocking the saver thread for the
+        # full commit timeout (which would wedge every later persist).
+        self._world_gen = 0
         AsyncCheckpointSaver._instance = self
 
     # -- lifecycle ------------------------------------------------------------
@@ -183,11 +187,13 @@ class AsyncCheckpointSaver:
         # rendezvous) can mutate num_hosts/world_hosts mid-persist, and a
         # torn read would pair host_i_of_4.meta with host_i_of_2.data or
         # mis-stamp the done marker.
+        # One atomic snapshot of (world, generation) drives the whole
+        # persist: cleanup keying, committer election AND the commit
+        # barrier's abort check.  Reading any of these later would race
+        # ``set_world`` from the agent thread.
+        world_gen = self._world_gen
         num_hosts = self.num_hosts
         world_hosts = list(self.world_hosts) if self.world_hosts else None
-        # Committer election must use the SAME snapshot: a rendezvous landing
-        # between persist and commit must not elect nobody (newest step never
-        # committed) or two committers.
         is_committer = (
             self.host_index == min(world_hosts) if world_hosts
             else self.host_index == 0
@@ -210,9 +216,12 @@ class AsyncCheckpointSaver:
             t0 = time.monotonic()
             step_dir = self.layout.step_dir(step)
             self.storage.safe_makedirs(step_dir)
-            if step not in self._cleaned_steps:
+            # Keyed by world generation: a re-persist of the same step under
+            # a NEW world must clean this saver's own previous-world files.
+            clean_key = (step, world_gen)
+            if clean_key not in self._cleaned_steps:
                 self._clean_stale_host_files(step, num_hosts, world_hosts)
-                self._cleaned_steps.add(step)
+                self._cleaned_steps.add(clean_key)
             self.storage.write(
                 pickle.dumps(meta),
                 self.layout.meta_path(step, self.host_index, num_hosts),
@@ -243,6 +252,7 @@ class AsyncCheckpointSaver:
                 expected_hosts=world_hosts,
                 num_hosts=num_hosts,
                 timeout=commit_timeout,
+                world_gen=world_gen,
             )
         return True
 
@@ -252,6 +262,7 @@ class AsyncCheckpointSaver:
         lowest live host id."""
         self.world_hosts = sorted(world_hosts)
         self.num_hosts = len(self.world_hosts)
+        self._world_gen += 1
         self._status.set("is_committer", self._is_committer())
 
     def _is_committer(self) -> bool:
@@ -337,22 +348,36 @@ class AsyncCheckpointSaver:
         expected_hosts: Optional[list] = None,
         num_hosts: Optional[int] = None,
         timeout: Optional[float] = None,
+        world_gen: Optional[int] = None,
     ):
         """The committer waits for every sealed-world host's done-file, then
-        flips the tracker.  ``expected_hosts``/``num_hosts`` are snapshots of
-        the world the step was saved under — never re-read mutable saver
-        state inside the poll loop."""
+        flips the tracker.  ``expected_hosts``/``num_hosts``/``world_gen``
+        are snapshots taken when the step was persisted — never re-read
+        mutable saver state inside the poll loop (and a ``set_world``
+        landing during a long persist must still trip the abort below)."""
         need = len(expected_hosts) if expected_hosts else (
             num_hosts if num_hosts is not None else self.num_hosts
         )
         deadline = time.monotonic() + (
             self.commit_timeout if timeout is None else timeout
         )
+        gen = self._world_gen if world_gen is None else world_gen
         # A stamp that matched once stays valid for this barrier's snapshot
         # — cache matches so the poll loop does one read per host, not one
         # per host per 0.5s tick (matters on object-store mounts).
         matched: set = set()
         while time.monotonic() < deadline:
+            if self._world_gen != gen or self._stopped.is_set():
+                # The world this step was saved under is gone (elastic
+                # restart) — its missing members will never write done
+                # files.  Abort now; the new world's next save re-persists
+                # and commits under the new membership.
+                logger.warning(
+                    "commit of step %d aborted: world changed mid-barrier",
+                    step,
+                )
+                self.storage.commit(step, False)
+                return
             if expected_hosts:
                 for h in expected_hosts:
                     if h not in matched and self._done_matches(step, h, need):

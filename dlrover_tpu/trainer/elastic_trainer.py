@@ -110,7 +110,13 @@ class ElasticTrainer:
             )
             if restored is not None:
                 self.state = restored
-                self.step = self._last_saved = restored_step
+                self.step = restored_step
+                # A restored step is NOT a step this world has committed:
+                # shm restores (and another world's uncommitted files) are
+                # exactly what elastic restarts resume from.  Leaving
+                # _last_saved behind the current step makes the end-of-fit
+                # persistence re-commit the state under THIS world.
+                self._last_saved = -1
                 logger.info(
                     "resumed from checkpoint at step %d", restored_step
                 )

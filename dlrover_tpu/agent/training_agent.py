@@ -171,6 +171,8 @@ class ElasticAgent:
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._resource_monitor = None
         self._paral_config_version = 0
+        self._log_path: Optional[str] = None
+        self._log_pump: Optional[threading.Thread] = None
 
     def _metrics_file(self) -> str:
         """Trainer->agent device-telemetry handoff file (ref
@@ -217,6 +219,24 @@ class ElasticAgent:
 
     # -- worker lifecycle -----------------------------------------------------
 
+    def _tail_log(self, n: int = 80) -> str:
+        """Last lines of the trainer's captured output (diagnosis payload,
+        ref ``elastic_agent/datacollector/log_collector.py``)."""
+        # Let the pump hit pipe EOF and write the final lines (the crash
+        # traceback is exactly what this tail exists to deliver).
+        if self._log_pump is not None:
+            self._log_pump.join(timeout=3.0)
+        if not self._log_path or not os.path.exists(self._log_path):
+            return ""
+        try:
+            with open(self._log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 16384))
+                lines = f.read().decode(errors="replace").splitlines()
+            return "\n".join(lines[-n:])
+        except OSError:
+            return ""
+
     def _start_workers(self) -> Dict:
         rdzv = self._rdzv.next_rendezvous()
         self._current_round = rdzv["round"]
@@ -243,9 +263,61 @@ class ElasticAgent:
             # max_nodes — an elastic world of 3/4 hosts must still commit,
             # and the committer is its lowest live host id.
             self._saver.set_world(sorted(rdzv["world"]))
-        self._proc = subprocess.Popen(self.entrypoint, env=env)
+        # Trainer output is teed: passed through to the agent's stdout AND
+        # captured to a per-node file so the failure path can report a log
+        # tail to the master (the log-collector diagnosis seam).
+        from dlrover_tpu.common.multi_process import socket_dir
+
+        os.makedirs(socket_dir(), exist_ok=True)
+        self._log_path = os.path.join(
+            socket_dir(), f"trainer_n{self.node_id}.log"
+        )
+        self._proc = subprocess.Popen(
+            self.entrypoint, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        self._log_pump = threading.Thread(
+            target=self._pump_output,
+            args=(self._proc.stdout, self._log_path),
+            name="trainer-log-pump",
+            daemon=True,
+        )
+        self._log_pump.start()
         self.client.report_event("started")
         return rdzv
+
+    def _pump_output(self, stream, log_path: str):
+        """Tee trainer output to our stdout + an unbuffered log file.
+
+        The pipe must be drained NO MATTER WHAT: an abandoned pipe fills
+        its 64KB buffer and blocks the trainer's next print mid-step.  A
+        sink that starts failing (broken stdout, unwritable disk) is
+        dropped individually; draining continues.
+        """
+        sinks = {"stdout": True, "file": True}
+        try:
+            log = open(log_path, "wb", buffering=0)
+        except OSError:
+            log, sinks["file"] = None, False
+        try:
+            for line in iter(stream.readline, b""):
+                if sinks["stdout"]:
+                    try:
+                        sys.stdout.buffer.write(line)
+                        sys.stdout.buffer.flush()
+                    except (OSError, ValueError):
+                        sinks["stdout"] = False
+                if sinks["file"]:
+                    try:
+                        log.write(line)
+                    except (OSError, ValueError):
+                        sinks["file"] = False
+        finally:
+            if log is not None:
+                try:
+                    log.close()
+                except OSError:
+                    pass
 
     def _stop_workers(self, sig=signal.SIGTERM, grace: float = 30.0):
         if self._proc is None or self._proc.poll() is not None:
@@ -257,6 +329,9 @@ class ElasticAgent:
             logger.warning("trainer ignored %s; killing", sig)
             self._proc.kill()
             self._proc.wait()
+        if self._log_pump is not None:
+            # Old pump must finish before a restart truncates the log file.
+            self._log_pump.join(timeout=3.0)
 
     def _restart_workers(self):
         """ref ``_restart_workers:687``: in-place process restart, no new pod."""
@@ -362,9 +437,13 @@ class ElasticAgent:
             # Failure path.
             logger.error("trainer exited with code %d", code)
             self._save_ckpt_to_storage()
+            tail = self._tail_log(30)
+            error = f"exit code {code}"
+            if tail:
+                error += f"\n--- trainer log tail ---\n{tail}"
             try:
                 action = self.client.report_failure(
-                    f"exit code {code}",
+                    error,
                     exit_code=code,
                     level="process",
                     restart_count=self._restart_count,

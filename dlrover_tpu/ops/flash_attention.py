@@ -187,6 +187,53 @@ def _flash_fwd(
 # ---------------------------------------------------------------------------
 
 
+def _block_mask(causal, q_start, kv_start, seg_q_ref, seg_kv_ref,
+                block_q, block_kv):
+    mask = None
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        cols = kv_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        mask = rows >= cols
+    seg = seg_q_ref[0, 0][:, None] == seg_kv_ref[0, 0][None, :]
+    return seg if mask is None else jnp.logical_and(mask, seg)
+
+
+def _recompute_p_ds(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    seg_q_ref, seg_kv_ref,
+    *, causal, scale, q_start, kv_start, block_q, block_kv,
+):
+    """Shared backward block math: probabilities p and score-grads ds.
+
+    The softmax recompute from lse and its masking MUST be identical across
+    the dq / dkv / fused kernels — one traced helper keeps them in sync.
+    """
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, 0][:, None]
+    delta = delta_ref[0, 0][:, 0][:, None]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    mask = _block_mask(
+        causal, q_start, kv_start, seg_q_ref, seg_kv_ref, block_q, block_kv
+    )
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    return p, ds
+
+
 def _bwd_dq_kernel(
     seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_acc_ref,
@@ -204,35 +251,14 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, 0][:, None]
-        delta = delta_ref[0, 0][:, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        mask = None
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            cols = kv_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            mask = rows >= cols
-        seg = seg_q_ref[0, 0][:, None] == seg_kv_ref[0, 0][None, :]
-        mask = seg if mask is None else jnp.logical_and(mask, seg)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            seg_q_ref, seg_kv_ref,
+            causal=causal, scale=scale, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_kv=block_kv,
         )
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_acc_ref[:] += jax.lax.dot(
-            ds, k, preferred_element_type=jnp.float32
+            ds, k_ref[0, 0], preferred_element_type=jnp.float32
         )
 
     @pl.when(ik == nk - 1)
@@ -258,40 +284,19 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
+        p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            seg_q_ref, seg_kv_ref,
+            causal=causal, scale=scale, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_kv=block_kv,
+        )
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, 0][:, None]
-        delta = delta_ref[0, 0][:, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        mask = None
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            cols = kv_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            mask = rows >= cols
-        seg = seg_q_ref[0, 0][:, None] == seg_kv_ref[0, 0][None, :]
-        mask = seg if mask is None else jnp.logical_and(mask, seg)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        pb = p.astype(do.dtype)
         dv_acc_ref[:] += jax.lax.dot_general(
-            pb, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -332,46 +337,25 @@ def _bwd_fused_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
+        p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            seg_q_ref, seg_kv_ref,
+            causal=causal, scale=scale, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_kv=block_kv,
+        )
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, 0][:, None]
-        delta = delta_ref[0, 0][:, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        mask = None
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            cols = kv_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            mask = rows >= cols
-        seg = seg_q_ref[0, 0][:, None] == seg_kv_ref[0, 0][None, :]
-        mask = seg if mask is None else jnp.logical_and(mask, seg)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        pb = p.astype(do.dtype)
         dv_acc_ref[:] += jax.lax.dot_general(
-            pb, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         # nk == 1 (enforced by the dispatcher): one visit per dq block.
         dq_ref[0, 0] = jax.lax.dot(
-            ds, k, preferred_element_type=jnp.float32
-        )
+            ds, k_ref[0, 0], preferred_element_type=jnp.float32
+        ).astype(dq_ref.dtype)
 
     @pl.when(iq == nq - 1)
     def _finalize_kv():
@@ -440,13 +424,12 @@ def _flash_bwd_fused(
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, hq, skv, d), k.dtype),
             jax.ShapeDtypeStruct((b, hq, skv, d), v.dtype),
         ],
         interpret=_interpret(),
     )(seg_q, seg_kv, q, k, v, do, lse_l, delta_l)
-    dq = dq.astype(q.dtype)
     if group > 1:
         dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2).astype(k.dtype)
         dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2).astype(v.dtype)

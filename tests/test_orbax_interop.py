@@ -1,0 +1,51 @@
+"""Flash Checkpoint <-> Orbax interop roundtrips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.checkpoint.orbax_interop import (
+    export_to_orbax,
+    flash_step_to_orbax,
+    import_from_orbax,
+)
+
+
+def test_orbax_roundtrip_plain_tree(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(12.0).reshape(3, 4)},
+        "step": jnp.asarray(7),
+    }
+    path = export_to_orbax(str(tmp_path / "ckpt"), state)
+    restored = import_from_orbax(path)
+    np.testing.assert_array_equal(
+        restored["params"]["w"], np.arange(12.0).reshape(3, 4)
+    )
+    assert int(restored["step"]) == 7
+
+
+def test_flash_step_exports_to_orbax(tmp_path):
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "flash")
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    saver.set_world([0])
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    state = {"w": jnp.full((4,), 2.5), "b": jnp.zeros((2,))}
+    engine.save_to_memory(11, state)
+    assert saver.save_step_checkpoint(11)
+
+    step, path = flash_step_to_orbax(
+        engine,
+        str(tmp_path / "orbax"),
+        treedef=jax.tree_util.tree_structure(state),
+    )
+    assert step == 11
+    restored = import_from_orbax(path)
+    np.testing.assert_allclose(restored["w"], np.full((4,), 2.5))
+    engine._shm.close(unlink=True)
+    engine.close()
+    saver.stop()

@@ -251,6 +251,10 @@ class ElasticAgent:
                 ENV_RESTART_COUNT: str(self._restart_count),
                 ConfigKey.METRICS_FILE: self._metrics_file(),
                 ConfigKey.PARAL_CONFIG_PATH: self._paral_config_file(),
+                # Piped stdout would flip the trainer to 8KB block
+                # buffering, holding back exactly the final prints the
+                # failure-report log tail exists to capture.
+                "PYTHONUNBUFFERED": "1",
             }
         )
         logger.info(
@@ -320,18 +324,27 @@ class ElasticAgent:
                     pass
 
     def _stop_workers(self, sig=signal.SIGTERM, grace: float = 30.0):
-        if self._proc is None or self._proc.poll() is not None:
-            return
-        self._proc.send_signal(sig)
-        try:
-            self._proc.wait(timeout=grace)
-        except subprocess.TimeoutExpired:
-            logger.warning("trainer ignored %s; killing", sig)
-            self._proc.kill()
-            self._proc.wait()
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.send_signal(sig)
+            try:
+                self._proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                logger.warning("trainer ignored %s; killing", sig)
+                self._proc.kill()
+                self._proc.wait()
         if self._log_pump is not None:
-            # Old pump must finish before a restart truncates the log file.
+            # The old pump must finish before a restart truncates the log
+            # file — including when the trainer is ALREADY dead (lingering
+            # grandchildren can keep the pipe open; close our read end so
+            # the pump unblocks instead of interleaving stale writes).
             self._log_pump.join(timeout=3.0)
+            if self._log_pump.is_alive() and self._proc is not None:
+                try:
+                    self._proc.stdout.close()
+                except (OSError, AttributeError):
+                    pass
+                self._log_pump.join(timeout=2.0)
+            self._log_pump = None
 
     def _restart_workers(self):
         """ref ``_restart_workers:687``: in-place process restart, no new pod."""

@@ -47,7 +47,10 @@ def import_from_orbax(
     import orbax.checkpoint as ocp
 
     checkpointer = _checkpointer()
-    if template is not None and shardings is not None:
+    if template is None:
+        return checkpointer.restore(path)
+    restore_args = None
+    if shardings is not None:
         restore_args = jax.tree.map(
             lambda t, s: ocp.ArrayRestoreArgs(
                 sharding=s, global_shape=getattr(t, "shape", None)
@@ -55,14 +58,13 @@ def import_from_orbax(
             template,
             shardings,
         )
-        return checkpointer.restore(
-            path,
-            args=ocp.args.PyTreeRestore(
-                item=template,
-                restore_args=restore_args,
-            ),
-        )
-    return checkpointer.restore(path)
+    return checkpointer.restore(
+        path,
+        args=ocp.args.PyTreeRestore(
+            item=template,
+            restore_args=restore_args,
+        ),
+    )
 
 
 def flash_step_to_orbax(

@@ -269,12 +269,16 @@ class ElasticAgent:
             self._saver.set_world(sorted(rdzv["world"]))
         # Trainer output is teed: passed through to the agent's stdout AND
         # captured to a per-node file so the failure path can report a log
-        # tail to the master (the log-collector diagnosis seam).
+        # tail to the master (the log-collector diagnosis seam).  The path
+        # is unique per restart: an old pump kept alive by a lingering
+        # grandchild's pipe handle can never scribble into the new round's
+        # log (there is no portable way to wake a thread blocked in read).
         from dlrover_tpu.common.multi_process import socket_dir
 
         os.makedirs(socket_dir(), exist_ok=True)
         self._log_path = os.path.join(
-            socket_dir(), f"trainer_n{self.node_id}.log"
+            socket_dir(),
+            f"trainer_n{self.node_id}_r{self._restart_count}.log",
         )
         self._proc = subprocess.Popen(
             self.entrypoint, env=env,
@@ -333,17 +337,16 @@ class ElasticAgent:
                 self._proc.kill()
                 self._proc.wait()
         if self._log_pump is not None:
-            # The old pump must finish before a restart truncates the log
-            # file — including when the trainer is ALREADY dead (lingering
-            # grandchildren can keep the pipe open; close our read end so
-            # the pump unblocks instead of interleaving stale writes).
+            # Best-effort: let the pump flush the final lines.  A pump kept
+            # alive by a lingering grandchild's pipe handle is abandoned —
+            # it writes to the PREVIOUS restart's uniquely-named log, so it
+            # cannot corrupt the next round's file.
             self._log_pump.join(timeout=3.0)
-            if self._log_pump.is_alive() and self._proc is not None:
-                try:
-                    self._proc.stdout.close()
-                except (OSError, AttributeError):
-                    pass
-                self._log_pump.join(timeout=2.0)
+            if self._log_pump.is_alive():
+                logger.warning(
+                    "trainer log pump still draining (grandchild holds the "
+                    "pipe?); abandoning it to its per-restart log file"
+                )
             self._log_pump = None
 
     def _restart_workers(self):

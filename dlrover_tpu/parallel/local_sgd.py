@@ -32,6 +32,7 @@ class LocalSGDConfig:
     outer_momentum: float = 0.9   # 0 = plain averaged delta
     method: str = "average"       # "average" | "gta"
     gta_threshold: float = 0.0    # min |consensus| fraction to keep a coord
+    quantized_comm: bool = False  # int8 delta transport over DCN
 
 
 def gta_reduce(deltas: List[Any], threshold: float = 0.0) -> Any:
@@ -86,7 +87,16 @@ class LocalSGD:
         allgather_fn: Optional[Callable[[Any], List[Any]]] = None,
     ):
         self.config = config
-        self.allgather_fn = allgather_fn or _default_allgather
+        if allgather_fn is None:
+            if config.quantized_comm:
+                from dlrover_tpu.parallel.quantized_collectives import (
+                    quantized_process_allgather,
+                )
+
+                allgather_fn = quantized_process_allgather
+            else:
+                allgather_fn = _default_allgather
+        self.allgather_fn = allgather_fn
         self._anchor = None      # outer params (pre-local-round)
         self._velocity = None    # outer momentum buffer
         self._local_steps = 0

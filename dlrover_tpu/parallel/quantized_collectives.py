@@ -1,0 +1,121 @@
+"""Quantized cross-replica all-reduce: int8 wire format for DCN gradients.
+
+Capability ref: the reference's quantization stack exists for *memory*
+(``atorch/atorch/ops/csrc/quantization``); the communication-side analogue
+on TPU is quantizing the cross-slice (DCN) gradient all-reduce, the one
+collective that rides the slow wire in the mesh layout policy
+(``runtime/mesh.py``: only ``dcn_data`` crosses slices).  Scheme follows
+the EQuARX shape (arXiv:2506.17615, PAPERS.md): two quantized phases
+instead of one fp all-reduce —
+
+  1. reduce-scatter phase: each replica quantizes its shard-of-others and
+     all-to-alls int8 blocks + fp scales; the owner dequantizes and sums
+     in fp32 (no int8 overflow);
+  2. broadcast phase: owners re-quantize their reduced shard and
+     all-gather int8 + scales.
+
+Wire bytes: ~(1 + 4/block) bytes/element per phase vs 2 (bf16) or 4
+(fp32) for the direct all-reduce — ~1.9x less DCN traffic than bf16 at
+block 256.  Use inside ``shard_map`` over the DCN axis; gradients only
+(symmetric-absmax block quantization error is well inside optimizer noise,
+asserted by the tests).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_quant(x: jax.Array, block: int) -> Tuple[jax.Array, jax.Array]:
+    """[N] fp -> (int8 [N], scales fp32 [N/block]); N padded by caller."""
+    rows = x.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(rows), axis=1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(rows / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale[:, 0].astype(jnp.float32)
+
+
+def _block_dequant(q: jax.Array, scales: jax.Array, block: int) -> jax.Array:
+    rows = q.reshape(-1, block).astype(jnp.float32)
+    return (rows * scales[:, None]).reshape(-1)
+
+
+def quantized_all_reduce(
+    x: jax.Array, axis_name: str, block: int = 256, mean: bool = True
+) -> jax.Array:
+    """All-reduce ``x`` over ``axis_name`` with an int8 wire format.
+
+    Call inside ``shard_map``/``pmap`` where ``axis_name`` is bound.  The
+    result is identical on every member (quantization error included), so
+    replicated-parameter invariants hold.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    # Pad so every member owns an equal whole-blocks shard.
+    shard = -(-flat.size // (n * block)) * block
+    flat = jnp.pad(flat, (0, shard * n - flat.size))
+
+    # Phase 1: quantize my n shards, all-to-all so member i receives every
+    # replica's shard i, dequantize + fp32 sum.
+    q, scales = _block_quant(flat, block)
+    q_shards = q.reshape(n, shard)
+    s_shards = scales.reshape(n, shard // block)
+    q_recv = jax.lax.all_to_all(q_shards, axis_name, 0, 0, tiled=False)
+    s_recv = jax.lax.all_to_all(s_shards, axis_name, 0, 0, tiled=False)
+    contributions = jax.vmap(
+        lambda qq, ss: _block_dequant(qq, ss, block)
+    )(q_recv, s_recv)
+    reduced = jnp.sum(contributions, axis=0)
+    if mean:
+        reduced = reduced / n
+
+    # Phase 2: re-quantize the reduced shard, all-gather int8 + scales.
+    q2, s2 = _block_quant(reduced, block)
+    q_all = jax.lax.all_gather(q2, axis_name, axis=0, tiled=False)
+    s_all = jax.lax.all_gather(s2, axis_name, axis=0, tiled=False)
+    out = jax.vmap(lambda qq, ss: _block_dequant(qq, ss, block))(
+        q_all, s_all
+    ).reshape(-1)
+    return out[: x.size].reshape(orig_shape).astype(orig_dtype)
+
+
+def quantized_process_allgather(local_tree, block: int = 256):
+    """Host-level quantized allgather: the Local-SGD outer-sync transport.
+
+    Each host quantizes its parameter-delta pytree to int8 + block scales,
+    allgathers the compressed payload across processes (DCN), and every
+    host dequantizes all replicas — the drop-in ``allgather_fn`` for
+    :class:`dlrover_tpu.parallel.local_sgd.LocalSGD` at ~1.9x less DCN
+    bytes than bf16 deltas.  Returns ``[tree_per_host]``.
+    """
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree_util.tree_flatten(local_tree)
+    shapes = [leaf.shape for leaf in leaves]
+    dtypes = [jnp.asarray(leaf).dtype for leaf in leaves]
+    payload = []
+    for leaf in leaves:
+        flat = jnp.asarray(leaf, jnp.float32).reshape(-1)
+        padded = -(-flat.size // block) * block
+        flat = jnp.pad(flat, (0, padded - flat.size))
+        q, s = _block_quant(flat, block)
+        payload.append((q, s))
+    gathered = multihost_utils.process_allgather(payload)
+    n = jax.process_count()
+    out = []
+    for host in range(n):
+        host_leaves = []
+        for (q_all, s_all), shape, dtype in zip(gathered, shapes, dtypes):
+            deq = _block_dequant(q_all[host], s_all[host], block)
+            size = 1
+            for dim in shape:
+                size *= dim
+            host_leaves.append(deq[:size].reshape(shape).astype(dtype))
+        out.append(jax.tree_util.tree_unflatten(treedef, host_leaves))
+    return out

@@ -173,6 +173,7 @@ class ElasticAgent:
         self._paral_config_version = 0
         self._log_path: Optional[str] = None
         self._log_pump: Optional[threading.Thread] = None
+        self._log_pump_stop = threading.Event()
 
     def _metrics_file(self) -> str:
         """Trainer->agent device-telemetry handoff file (ref
@@ -280,13 +281,25 @@ class ElasticAgent:
             socket_dir(),
             f"trainer_n{self.node_id}_r{self._restart_count}.log",
         )
+        # Bounded retention: keep this round's and the previous round's
+        # logs; a flapping trainer must not grow the dir forever.
+        stale = os.path.join(
+            socket_dir(),
+            f"trainer_n{self.node_id}_r{self._restart_count - 2}.log",
+        )
+        if self._restart_count >= 2 and os.path.exists(stale):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
         self._proc = subprocess.Popen(
             self.entrypoint, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
+        self._log_pump_stop = threading.Event()
         self._log_pump = threading.Thread(
             target=self._pump_output,
-            args=(self._proc.stdout, self._log_path),
+            args=(self._proc.stdout, self._log_path, self._log_pump_stop),
             name="trainer-log-pump",
             daemon=True,
         )
@@ -294,13 +307,16 @@ class ElasticAgent:
         self.client.report_event("started")
         return rdzv
 
-    def _pump_output(self, stream, log_path: str):
+    def _pump_output(self, stream, log_path: str, stop_flag):
         """Tee trainer output to our stdout + an unbuffered log file.
 
         The pipe must be drained NO MATTER WHAT: an abandoned pipe fills
-        its 64KB buffer and blocks the trainer's next print mid-step.  A
+        its 64KB buffer and blocks the writer's next print mid-step.  A
         sink that starts failing (broken stdout, unwritable disk) is
-        dropped individually; draining continues.
+        dropped individually; draining continues.  ``stop_flag`` silences
+        the stdout sink once this round is abandoned — a lingering
+        grandchild's late lines must not interleave with the NEXT round's
+        output (they still land in this round's own log file).
         """
         sinks = {"stdout": True, "file": True}
         try:
@@ -309,6 +325,8 @@ class ElasticAgent:
             log, sinks["file"] = None, False
         try:
             for line in iter(stream.readline, b""):
+                if stop_flag.is_set():
+                    sinks["stdout"] = False
                 if sinks["stdout"]:
                     try:
                         sys.stdout.buffer.write(line)
@@ -347,6 +365,7 @@ class ElasticAgent:
                     "trainer log pump still draining (grandchild holds the "
                     "pipe?); abandoning it to its per-restart log file"
                 )
+                self._log_pump_stop.set()  # silence its stdout sink
             self._log_pump = None
 
     def _restart_workers(self):

@@ -23,6 +23,7 @@ asserted by the tests).
 
 from __future__ import annotations
 
+import math
 from typing import Tuple
 
 import jax
@@ -96,6 +97,9 @@ def quantized_process_allgather(local_tree, block: int = 256):
     """
     from jax.experimental import multihost_utils
 
+    if jax.process_count() == 1:
+        # No wire to compress: exact and free.
+        return [local_tree]
     leaves, treedef = jax.tree_util.tree_flatten(local_tree)
     shapes = [leaf.shape for leaf in leaves]
     dtypes = [jnp.asarray(leaf).dtype for leaf in leaves]
@@ -113,9 +117,7 @@ def quantized_process_allgather(local_tree, block: int = 256):
         host_leaves = []
         for (q_all, s_all), shape, dtype in zip(gathered, shapes, dtypes):
             deq = _block_dequant(q_all[host], s_all[host], block)
-            size = 1
-            for dim in shape:
-                size *= dim
+            size = math.prod(shape)
             host_leaves.append(deq[:size].reshape(shape).astype(dtype))
         out.append(jax.tree_util.tree_unflatten(treedef, host_leaves))
     return out

@@ -73,8 +73,8 @@ def test_quantized_all_reduce_single_member_is_identity():
 
 
 def test_local_sgd_quantized_transport_single_host():
-    """quantized_process_allgather degrades to [dequant(quant(tree))] in a
-    one-process world; the outer loop still converges through it."""
+    """In a one-process world the transport takes the exact early exit
+    (nothing to compress); the quantized-comm outer loop stays exact."""
     from dlrover_tpu.parallel.local_sgd import LocalSGD, LocalSGDConfig
     from dlrover_tpu.parallel.quantized_collectives import (
         quantized_process_allgather,
@@ -84,15 +84,36 @@ def test_local_sgd_quantized_transport_single_host():
                              jnp.float32)}
     out = quantized_process_allgather(tree, block=128)
     assert len(out) == 1
-    np.testing.assert_allclose(out[0]["w"], tree["w"], atol=0.05)
+    np.testing.assert_array_equal(out[0]["w"], tree["w"])
 
     outer = LocalSGD(LocalSGDConfig(
         sync_every=2, outer_momentum=0.0, quantized_comm=True,
     ))
     params = {"w": jnp.zeros((300,))}
     outer.init(params)
-    params = {"w": jnp.full((300,), 1.0)}
     params, _ = outer.maybe_sync({"w": jnp.full((300,), 0.5)})
     params, synced = outer.maybe_sync({"w": jnp.full((300,), 1.0)})
     assert synced
-    np.testing.assert_allclose(params["w"], 1.0, atol=0.02)
+    np.testing.assert_allclose(params["w"], 1.0, atol=1e-6)
+
+
+def test_quantized_transport_multi_host_payload_roundtrip():
+    """The lossy wire path itself (quant -> gather -> dequant per host),
+    exercised without a multi-process world by driving the payload
+    transform directly."""
+    from dlrover_tpu.parallel.quantized_collectives import (
+        _block_dequant,
+        _block_quant,
+    )
+
+    rng = np.random.default_rng(3)
+    delta = jnp.asarray(rng.normal(size=(300,)), jnp.bfloat16)
+    flat = jnp.asarray(delta, jnp.float32).reshape(-1)
+    padded = -(-flat.size // 128) * 128
+    q, s = _block_quant(jnp.pad(flat, (0, padded - flat.size)), 128)
+    back = _block_dequant(q, s, 128)[: flat.size].astype(jnp.bfloat16)
+    assert back.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(back, np.float32), np.asarray(delta, np.float32),
+        atol=0.06,
+    )

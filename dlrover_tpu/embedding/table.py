@@ -41,6 +41,7 @@ class EmbeddingTable:
         eps: float = 1e-8,
         weight_decay: float = 0.0,
         native: Optional[bool] = None,
+        spill_path: Optional[str] = None,
     ):
         self.name = name
         self.dim = dim
@@ -49,7 +50,16 @@ class EmbeddingTable:
         self.learning_rate = learning_rate
         self.b1, self.b2, self.eps = b1, b2, eps
         self.weight_decay = weight_decay
-        self.store = KVStore(dim, native=native)
+        if spill_path:
+            # Hybrid mem/disk tier (ref tfplus hybrid_embedding): cold
+            # features demote to disk and fault back on access.
+            from dlrover_tpu.embedding.spill import HybridKVStore
+
+            self.store = HybridKVStore(
+                dim, spill_path=spill_path, native=native
+            )
+        else:
+            self.store = KVStore(dim, native=native)
         self.step = 0
         self._adam_t = 0
         self._last_export_step = 0
@@ -90,6 +100,17 @@ class EmbeddingTable:
         ``max_age_steps`` (feature freshness, ref kv_variable delete ops)."""
         cutoff = max(0, self.step - max_age_steps)
         return self.store.evict(cutoff, min_count)
+
+    def spill(self, max_age_steps: int, min_count: int = 1) -> int:
+        """Demote cold features to the disk tier (hybrid stores only);
+        they fault back into RAM on their next lookup."""
+        if not hasattr(self.store, "spill"):
+            raise ValueError(
+                "spill requires a hybrid store: pass spill_path= to "
+                "EmbeddingTable"
+            )
+        cutoff = max(0, self.step - max_age_steps)
+        return self.store.spill(cutoff, min_count)
 
     # -- checkpoint (full + delta) --------------------------------------------
 

@@ -1,0 +1,175 @@
+"""Hybrid mem/disk embedding tier: spill, fault-back, checkpoint, compact."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.embedding.spill import HybridKVStore, SpillFile
+from dlrover_tpu.embedding.table import EmbeddingTable
+
+
+def test_spill_and_fault_back_preserves_training_state(tmp_path):
+    store = HybridKVStore(8, str(tmp_path / "spill.log"), native=False)
+    keys = np.array([1, 2, 3], np.int64)
+    store.lookup(keys, init_scale=0.1, seed=0, step=1)
+    grads = np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32)
+    store.apply_group_adam(keys, grads, lr=0.1, t=1)
+    rows_before = store.peek(keys).copy()
+
+    # Touch key 3 later so it stays hot; spill the rest.
+    store.lookup(np.array([3], np.int64), 0.1, 0, step=10)
+    spilled = store.spill(min_step=5, min_count=10)
+    assert spilled == 2
+    assert store.ram_rows == 1 and store.disk_rows == 2
+    assert len(store) == 3
+
+    # peek serves disk rows without promoting them.
+    np.testing.assert_allclose(store.peek(keys), rows_before, atol=1e-6)
+    assert store.disk_rows == 2
+
+    # lookup faults them back WITH moments: a further identical Adam step
+    # on a pure-RAM twin must match exactly.
+    twin = HybridKVStore(8, str(tmp_path / "twin.log"), native=False)
+    twin.lookup(keys, init_scale=0.1, seed=0, step=1)
+    twin.apply_group_adam(keys, grads, lr=0.1, t=1)
+
+    store.lookup(keys, 0.1, 0, step=11)
+    assert store.disk_rows == 0 and store.ram_rows == 3
+    grads2 = np.ones((3, 8), np.float32)
+    store.apply_group_adam(keys, grads2, lr=0.1, t=2)
+    twin.apply_group_adam(keys, grads2, lr=0.1, t=2)
+    np.testing.assert_allclose(
+        store.peek(keys), twin.peek(keys), rtol=1e-6, atol=1e-7
+    )
+    store.close()
+    twin.close()
+
+
+def test_full_export_spans_both_tiers(tmp_path):
+    store = HybridKVStore(4, str(tmp_path / "s.log"), native=False)
+    store.lookup(np.arange(6, dtype=np.int64), 0.1, 0, step=1)
+    store.lookup(np.array([5], np.int64), 0.1, 0, step=9)
+    assert store.spill(min_step=5, min_count=10) == 5
+    keys, rows, m, v, counts, steps = store.export()
+    assert sorted(keys.tolist()) == [0, 1, 2, 3, 4, 5]
+    assert rows.shape == (6, 4)
+    # Delta export filters both tiers by recency (spilled rows here are
+    # older than the window).
+    dkeys, *_ = store.export(min_step=9)
+    assert dkeys.tolist() == [5]
+    store.close()
+
+
+def test_spill_log_survives_reopen_and_compacts(tmp_path):
+    path = str(tmp_path / "s.log")
+    store = HybridKVStore(4, path, native=False)
+    store.lookup(np.array([7, 8], np.int64), 0.1, 3, step=1)
+    baseline = store.peek(np.array([7, 8], np.int64)).copy()
+    store.spill(min_step=2, min_count=10)
+    store.close()
+
+    # Fresh process: the index rebuilds from the log.
+    reopened = SpillFile(path, 4)
+    assert len(reopened) == 2
+    row7 = reopened.read(7)[0]
+    np.testing.assert_allclose(row7, baseline[0], atol=1e-6)
+
+    # Re-spill a newer generation of key 7, then compact drops the old one.
+    reopened.append(7, np.ones(4), np.zeros(4), np.zeros(4), 5, 9)
+    reopened.flush()
+    size_before = (tmp_path / "s.log").stat().st_size
+    reopened.compact()
+    assert (tmp_path / "s.log").stat().st_size < size_before
+    np.testing.assert_allclose(reopened.read(7)[0], np.ones(4))
+    assert reopened.read(7)[3] == 5  # count survived
+    reopened.close()
+
+
+def test_table_level_spill_api(tmp_path):
+    table = EmbeddingTable(
+        "hybrid", dim=8, learning_rate=0.1,
+        spill_path=str(tmp_path / "hybrid.log"),
+    )
+    table.lookup(np.arange(10, dtype=np.int64))
+    for _ in range(20):
+        table.lookup(np.array([0, 1], np.int64))  # keep two keys hot
+    spilled = table.spill(max_age_steps=5, min_count=3)
+    assert spilled == 8
+    assert len(table) == 10  # logical size spans both tiers
+    # Checkpoint roundtrip includes the spilled tier.
+    table.save(str(tmp_path / "ckpt"), step=21)
+    fresh = EmbeddingTable("hybrid", dim=8, learning_rate=0.1)
+    fresh.restore(str(tmp_path / "ckpt"))
+    assert len(fresh) == 10
+    table.store.close()
+
+
+def test_plain_table_rejects_spill():
+    table = EmbeddingTable("plain", dim=4)
+    with pytest.raises(ValueError, match="hybrid"):
+        table.spill(max_age_steps=1)
+
+
+def test_fault_back_deletion_survives_restart(tmp_path):
+    """A faulted-back key's disk record must stay dead across an index
+    rebuild — a resurrected stale record would clobber newer training."""
+    path = str(tmp_path / "s.log")
+    store = HybridKVStore(4, path, native=False)
+    keys = np.array([9], np.int64)
+    store.lookup(keys, 0.1, 0, step=1)
+    store.spill(min_step=2, min_count=10)
+    assert store.disk_rows == 1
+    store.lookup(keys, 0.1, 0, step=5)           # fault back
+    store.apply_group_adam(keys, np.ones((1, 4), np.float32), lr=0.5, t=1)
+    trained = store.peek(keys).copy()
+    store.disk.flush()
+    store.close()
+
+    reopened = SpillFile(path, 4)
+    assert 9 not in reopened                     # tombstone honored
+    reopened.close()
+    # Fresh hybrid store + checkpoint-restore-style insert of the trained
+    # row: a later lookup must NOT overwrite it with stale disk state.
+    fresh = HybridKVStore(4, path, native=False)
+    fresh.insert(keys, trained)
+    out = fresh.lookup(keys, 0.1, 0, step=6)
+    np.testing.assert_allclose(out, trained, atol=1e-6)
+    fresh.close()
+
+
+def test_insert_tombstones_existing_disk_copy(tmp_path):
+    store = HybridKVStore(4, str(tmp_path / "s.log"), native=False)
+    keys = np.array([3], np.int64)
+    store.lookup(keys, 0.1, 0, step=1)
+    store.spill(min_step=2, min_count=10)
+    newer = np.full((1, 4), 7.0, np.float32)
+    store.insert(keys, newer)
+    assert store.disk_rows == 0 and len(store) == 1
+    out = store.lookup(keys, 0.1, 0, step=3)     # no stale fault-in
+    np.testing.assert_allclose(out, newer)
+    store.close()
+
+
+def test_delta_export_includes_recently_trained_spilled_rows(tmp_path):
+    """A row trained inside the delta window then spilled must appear in
+    the delta export (restores without the spill file would lose it)."""
+    store = HybridKVStore(4, str(tmp_path / "s.log"), native=False)
+    store.lookup(np.array([1], np.int64), 0.1, 0, step=100)
+    store.lookup(np.array([2], np.int64), 0.1, 0, step=200)
+    store.spill(min_step=150, min_count=10)      # spills key 1 (step 100)
+    dkeys, *_ = store.export(min_step=91)        # delta window from 91
+    assert sorted(dkeys.tolist()) == [1, 2]
+    store.close()
+
+
+def test_truncated_tail_record_is_dropped(tmp_path):
+    path = str(tmp_path / "s.log")
+    store = HybridKVStore(4, path, native=False)
+    store.lookup(np.array([1, 2], np.int64), 0.1, 0, step=1)
+    store.spill(min_step=2, min_count=10)
+    store.close()
+    with open(path, "ab") as f:                  # crash mid-append
+        f.write(b"\x07\x00\x00\x00")
+    reopened = SpillFile(path, 4)
+    assert len(reopened) == 2                    # intact records survive
+    assert reopened.read(1) is not None
+    reopened.close()

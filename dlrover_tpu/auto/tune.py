@@ -87,9 +87,11 @@ _RECOMPUTE_FRACTION = {
 class Candidate:
     parallel: ParallelConfig
     remat: str
+    global_batch_size: int = 0   # 0 = the caller's requested batch
     est_step_time: float = math.inf
     est_hbm_gb: float = math.inf
     measured_step_time: Optional[float] = None
+    measured_tokens_per_sec: Optional[float] = None
     rejected: str = ""
 
     def describe(self) -> str:
@@ -99,7 +101,8 @@ class Candidate:
             "sp": p.seq, "ep": p.expert, "pp": p.pipe,
         }
         live = ",".join(f"{k}={v}" for k, v in axes.items() if v not in (1,))
-        return f"[{live or 'dp=1'} remat={self.remat}]"
+        batch = f" gbs={self.global_batch_size}" if self.global_batch_size else ""
+        return f"[{live or 'dp=1'} remat={self.remat}{batch}]"
 
 
 @dataclasses.dataclass
@@ -108,6 +111,7 @@ class TuneResult:
     model_config: TransformerConfig
     remat: str
     candidates: List[Candidate]
+    global_batch_size: int = 0  # only set by search_batch=True
 
     @property
     def best(self) -> Candidate:
@@ -307,7 +311,7 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
     p = best.parallel
     key = np.asarray(
         [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
-         _REMAT_CODES.get(best.remat, -1)],
+         _REMAT_CODES.get(best.remat, -1), best.global_batch_size],
         np.int64,
     )
     agreed = multihost_utils.broadcast_one_to_all(key)
@@ -319,10 +323,14 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         expert=int(agreed[3]), seq=int(agreed[4]), tensor=int(agreed[5]),
     )
     remat = codes.get(int(agreed[6]), best.remat)
+    batch = int(agreed[7])
     for cand in ranked:
-        if cand.parallel == parallel and cand.remat == remat:
+        if (
+            cand.parallel == parallel and cand.remat == remat
+            and cand.global_batch_size == batch
+        ):
             return cand
-    return Candidate(parallel, remat)
+    return Candidate(parallel, remat, global_batch_size=batch)
 
 
 def auto_tune(
@@ -336,6 +344,7 @@ def auto_tune(
     measure: bool = True,
     devices=None,
     include_pipeline: bool = True,
+    search_batch: bool = False,
 ) -> TuneResult:
     """Find the best (ParallelConfig, remat) for ``config`` on this mesh.
 
@@ -343,22 +352,48 @@ def auto_tune(
     ``auto_accelerate(model, optim_func, ...)``; returns a ``TuneResult``
     whose ``parallel``/``model_config`` plug straight into
     ``build_mesh`` + ``build_sharded_train``.
+
+    ``search_batch=True`` additionally searches global batch sizes (1x/2x/
+    4x the requested batch — the reference HyperParam tuner's knob) and
+    ranks by estimated *throughput* instead of step time; the winner's
+    batch lands on ``TuneResult.global_batch_size``.  Opt-in because a
+    changed batch changes training semantics.
     """
     devices = list(devices if devices is not None else jax.devices())
     n_devices = n_devices or len(devices)
     devices = devices[:n_devices]
     seq_len = seq_len or config.max_seq_len
 
-    candidates = enumerate_candidates(
+    base = enumerate_candidates(
         config, n_devices, include_pipeline=include_pipeline
     )
+    if search_batch:
+        candidates = []
+        for mult in (1, 2, 4):
+            for cand in base:
+                candidates.append(
+                    dataclasses.replace(
+                        cand, global_batch_size=global_batch_size * mult
+                    )
+                )
+    else:
+        candidates = base
     for cand in candidates:
         _estimate(
-            cand, config, global_batch_size, seq_len, optimizer, n_devices
+            cand, config,
+            cand.global_batch_size or global_batch_size,
+            seq_len, optimizer, n_devices,
         )
+    def est_rank(c: Candidate) -> float:
+        if not search_batch:
+            return c.est_step_time
+        batch = c.global_batch_size or global_batch_size
+        # Throughput objective: bigger batches may take longer steps but
+        # move more tokens.
+        return -(batch * seq_len / c.est_step_time)
+
     feasible = sorted(
-        (c for c in candidates if not c.rejected),
-        key=lambda c: c.est_step_time,
+        (c for c in candidates if not c.rejected), key=est_rank
     )
     if not feasible:
         raise ValueError(
@@ -372,25 +407,58 @@ def auto_tune(
         [c.describe() for c in feasible[:5]],
     )
     if measure:
-        finalists = feasible[:max_measure]
+        if search_batch:
+            # Diversify finalists across batch sizes: the analytic model
+            # favors the largest batch monotonically, so a top-k slice
+            # would measure only 4x variants — one systematic estimator
+            # error (e.g. a real-world OOM) would invalidate every
+            # finalist at once with the safe batches never tried.
+            finalists, seen_batches = [], set()
+            for cand in feasible:
+                if cand.global_batch_size not in seen_batches:
+                    finalists.append(cand)
+                    seen_batches.add(cand.global_batch_size)
+                if len(finalists) >= max_measure:
+                    break
+            for cand in feasible:
+                if len(finalists) >= max_measure:
+                    break
+                if cand not in finalists:
+                    finalists.append(cand)
+        else:
+            finalists = feasible[:max_measure]
         for cand in finalists:
+            batch = cand.global_batch_size or global_batch_size
             cand.measured_step_time = _measure(
-                cand, config, global_batch_size, seq_len, optimizer, devices
+                cand, config, batch, seq_len, optimizer, devices
             )
+            if cand.measured_step_time:
+                cand.measured_tokens_per_sec = (
+                    batch * seq_len / cand.measured_step_time
+                )
         measured = [
             c for c in finalists if c.measured_step_time is not None
         ]
-        ranked = sorted(
-            measured, key=lambda c: c.measured_step_time
-        ) + [c for c in feasible if c not in measured]
+
+        def measured_rank(c: Candidate) -> float:
+            if search_batch:
+                return -(c.measured_tokens_per_sec or 0.0)
+            return c.measured_step_time
+
+        ranked = sorted(measured, key=measured_rank) + [
+            c for c in feasible if c not in measured
+        ]
     else:
         ranked = feasible
     best = ranked[0]
     if jax.process_count() > 1:
         # Hosts measure wall-clock independently; near-ties can rank
         # differently per host, and divergent strategies compile mismatched
-        # collectives (distributed hang).  Host 0's pick is authoritative.
+        # collectives (distributed hang).  Host 0's pick is authoritative —
+        # and must ALSO lead `candidates`, or result.best would diverge
+        # across hosts while result.parallel agrees.
         best = _broadcast_choice(best, ranked)
+        ranked = [best] + [c for c in ranked if c is not best]
     logger.info(
         "auto_tune: selected %s (est %.3fs, measured %s)",
         best.describe(), best.est_step_time,
@@ -407,4 +475,7 @@ def auto_tune(
         model_config=model_cfg,
         remat=best.remat,
         candidates=ranked,
+        # 0 (the sentinel) whenever batch search was off: every candidate
+        # then carries it.
+        global_batch_size=best.global_batch_size,
     )

@@ -76,3 +76,24 @@ def test_auto_tune_memory_pruning_rejects_oversized():
     # with remat=none cannot fit a single device's share.
     _estimate(dp_only[0], big, 64, 1024, "adamw", 8)
     assert dp_only[0].rejected
+
+
+def test_auto_tune_batch_search_opt_in():
+    """search_batch explores batch multiples, ranks by throughput, and
+    reports the winner's batch; default search leaves batch untouched."""
+    n = min(8, len(jax.devices()))
+    result = auto_tune(
+        tiny_cfg(),
+        global_batch_size=16,
+        n_devices=n,
+        optimizer="adamw",
+        max_measure=2,
+        search_batch=True,
+    )
+    assert result.global_batch_size in (16, 32, 64)
+    assert result.best.measured_tokens_per_sec is not None
+    # Default path keeps the sentinel (caller's batch stands).
+    plain = auto_tune(
+        tiny_cfg(), global_batch_size=16, n_devices=n, measure=False,
+    )
+    assert plain.global_batch_size == 0

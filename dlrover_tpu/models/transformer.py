@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from dlrover_tpu.models import layers
 from dlrover_tpu.models.attention import Attention
 from dlrover_tpu.models.moe import MoEMlp
+from dlrover_tpu.ops.layout_pin import pin_layout
 from dlrover_tpu.parallel import rules as lr
 
 
@@ -64,7 +65,14 @@ class TransformerConfig:
     fused_qkv: bool = True
     flash_block_q: int = 1024      # measured fastest on v5e at seq 1024
     flash_block_kv: int = 1024
-    remat: str = "none"            # "none" | "dots" | "full"
+    # Layout firewall around the attention block: the flash kernel's fixed
+    # operand layouts otherwise flip the whole layer seq-minor and the MLP
+    # matmuls lower to ~40%-MXU windowed emitters (see ops/layout_pin.py).
+    pin_attn_layouts: bool = False
+    remat: str = "none"            # one of _REMAT_POLICIES below: "none",
+                                   # "dots", "dots_no_batch", "full",
+                                   # "attn_out", "branch_out", "flash_res",
+                                   # "flash_only" (last two: flash impl only)
     scan_layers: bool = True
     scan_unroll: int = 1           # layers per scan iteration (XLA overlap)
     logits_dtype: Any = jnp.float32
@@ -92,6 +100,17 @@ class TransformerConfig:
             raise ValueError(
                 f"remat must be one of {sorted(_REMAT_POLICIES)}, got "
                 f"{self.remat!r}"
+            )
+        if self.remat in ("flash_only", "flash_res") and (
+            self.attention_impl != "flash"
+        ):
+            # The flash_out/flash_lse names only exist inside the flash
+            # kernel's custom_vjp: under any other impl these policies would
+            # silently save nothing (= remat "full") and the HFU accounting
+            # keyed on the remat string would be wrong.
+            raise ValueError(
+                f"remat={self.remat!r} requires attention_impl='flash', got "
+                f"{self.attention_impl!r}"
             )
 
     @property
@@ -175,6 +194,8 @@ class Block(nn.Module):
         cfg = self.config
         x, aux = carry
         x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
+        if cfg.pin_attn_layouts:
+            x = pin_layout(x)
         y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_attn")(x)
         y = Attention(
             num_heads=cfg.num_heads,
@@ -191,6 +212,8 @@ class Block(nn.Module):
             flash_block_kv=cfg.flash_block_kv,
             name="attn",
         )(y, positions, segment_ids)
+        if cfg.pin_attn_layouts:
+            y = pin_layout(y)
         # Named checkpoint: under the "attn_out" remat policy the backward
         # skips re-running the whole attention forward (the priciest part of
         # recompute) at b*s*d bf16 per layer of extra HBM.
@@ -241,6 +264,21 @@ _REMAT_POLICIES = {
     # the wo-matmul recompute for reconstructing the residual stream
     "branch_out": jax.checkpoint_policies.save_only_these_names(
         "attn_out", "mlp_out"
+    ),
+    # attn_out + the flash kernel's own outputs (o, lse — named inside the
+    # custom_vjp fwd rule, ops/flash_attention.py): the backward replay
+    # DCEs the attention forward recompute entirely and feeds the saved
+    # residuals straight into the dq/dkv kernels.  Costs one extra
+    # b*s*h*hd bf16 tensor (+small lse) per layer over "attn_out".
+    "flash_res": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "flash_out", "flash_lse"
+    ),
+    # flash kernel residuals only: backward recomputes the (cheap)
+    # out-projection from the saved kernel output instead of saving the
+    # post-projection activation too — lowest-HBM way to skip the
+    # attention-forward recompute.
+    "flash_only": jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse"
     ),
 }
 

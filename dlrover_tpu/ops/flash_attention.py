@@ -23,6 +23,7 @@ import functools
 from typing import Optional, Tuple
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
@@ -565,6 +566,12 @@ def _flash_core_fwd(q, k, v, seg_q, seg_kv, causal, scale, block_q, block_kv):
         q, k, v, seg_q, seg_kv,
         causal=causal, scale=scale, block_q=block_q, block_kv=block_kv,
     )
+    # Named remat saveables: under the "flash_res" policy (models/transformer)
+    # the first forward saves o+lse and the backward replay DCEs the whole
+    # forward kernel recompute — the bwd kernels read the saved tensors
+    # directly.  Under any other policy the names are no-ops.
+    o = jax.ad_checkpoint.checkpoint_name(o, "flash_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, seg_q, seg_kv, o, lse)
 
 

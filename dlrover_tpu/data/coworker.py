@@ -168,18 +168,28 @@ class CoworkerDataLoader:
                 for index in self._indices():
                     batch.append(index)
                     if len(batch) == self.batch_size:
-                        while not (
-                            feeder_done.is_set() or self._stop.is_set()
-                        ):
-                            try:
-                                self._task_queue.put((seq, batch),
-                                                     timeout=0.2)
-                                break
-                            except _queue.Full:
-                                continue
-                        else:
-                            return
+                        # Count the seq BEFORE the put and roll back if the
+                        # put never lands: counting after would let a feeder
+                        # dying between put and count drop the in-flight
+                        # batch silently (consumer exit condition undershoots)
                         submitted["n"] = seq + 1
+                        put_ok = False
+                        try:
+                            while not (
+                                feeder_done.is_set() or self._stop.is_set()
+                            ):
+                                try:
+                                    self._task_queue.put((seq, batch),
+                                                         timeout=0.2)
+                                    put_ok = True
+                                    break
+                                except _queue.Full:
+                                    continue
+                        finally:
+                            if not put_ok:
+                                submitted["n"] = seq
+                        if not put_ok:
+                            return
                         seq += 1
                         batch = []
             finally:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -90,8 +91,13 @@ class SpillFile:
         self._file.write(payload)
         self._index[int(key)] = offset
 
-    def flush(self):
+    def flush(self, durable: bool = False):
         self._file.flush()
+        if durable:
+            # Page-cache flush alone is not crash-safe: a demote that
+            # evicts the RAM copy before the OS writes the page back would
+            # lose the row from both tiers on power loss.
+            os.fsync(self._file.fileno())
 
     def read(self, key: int) -> Optional[Tuple]:
         offset = self._index.get(int(key))
@@ -165,9 +171,15 @@ class HybridKVStore:
         self.dim = dim
         self.ram = KVStore(dim, native=native)
         self.disk = SpillFile(spill_path, dim)
+        # Serializes compound two-tier operations: SpillFile shares one
+        # reader handle (seek+read pairs), and e.g. a checkpoint thread's
+        # export() interleaving with lookup()'s fault-in would read through
+        # another thread's seek offset.  The RAM tier has its own lock.
+        self._mu = threading.RLock()
 
     def __len__(self) -> int:
-        return len(self.ram) + len(self.disk)
+        with self._mu:
+            return len(self.ram) + len(self.disk)
 
     @property
     def ram_rows(self) -> int:
@@ -179,112 +191,136 @@ class HybridKVStore:
 
     def _fault_in(self, keys: np.ndarray) -> int:
         """Promote any spilled keys back into RAM; returns faults."""
-        faulted = 0
-        for key in np.unique(np.asarray(keys, np.int64)):
-            record = self.disk.read(int(key))
-            if record is None:
-                continue
-            row, m, v, count, step = record
-            self.ram.insert(
-                np.asarray([key], np.int64),
-                row[None], m[None], v[None],
-                np.asarray([count], np.uint32),
-                np.asarray([step], np.uint32),
-            )
-            self.disk.remove(int(key))
-            faulted += 1
-        return faulted
+        with self._mu:
+            faulted = 0
+            for key in np.unique(np.asarray(keys, np.int64)):
+                record = self.disk.read(int(key))
+                if record is None:
+                    continue
+                row, m, v, count, step = record
+                self.ram.insert(
+                    np.asarray([key], np.int64),
+                    row[None], m[None], v[None],
+                    np.asarray([count], np.uint32),
+                    np.asarray([step], np.uint32),
+                )
+                self.disk.remove(int(key))
+                faulted += 1
+            return faulted
 
     def lookup(self, keys: np.ndarray, init_scale: float = 0.01,
                seed: int = 0, step: int = 0) -> np.ndarray:
-        faults = self._fault_in(keys)
-        if faults:
-            logger.debug("embedding spill: faulted %d rows back", faults)
-        return self.ram.lookup(keys, init_scale, seed, step)
+        with self._mu:
+            faults = self._fault_in(keys)
+            if faults:
+                logger.debug("embedding spill: faulted %d rows back", faults)
+            return self.ram.lookup(keys, init_scale, seed, step)
 
     def peek(self, keys: np.ndarray) -> np.ndarray:
         """Read-only: serves RAM rows and disk rows without promotion."""
-        out = self.ram.peek(keys)
-        flat = np.asarray(keys, np.int64).reshape(-1)
-        for i, key in enumerate(flat.tolist()):
-            if not out[i].any() and key in self.disk:
-                record = self.disk.read(key)
-                if record is not None:
-                    out[i] = record[0]
-        return out
+        with self._mu:
+            out = self.ram.peek(keys)
+            flat = np.asarray(keys, np.int64).reshape(-1)
+            for i, key in enumerate(flat.tolist()):
+                if not out[i].any() and key in self.disk:
+                    record = self.disk.read(key)
+                    if record is not None:
+                        out[i] = record[0]
+            return out
 
+    # Gradients only exist for rows lookup() faulted in this step, so every
+    # group-sparse optimizer applies against the RAM tier alone.
     def apply_group_adam(self, *args, **kwargs):
-        # Gradients only exist for rows lookup() faulted in this step.
-        self.ram.apply_group_adam(*args, **kwargs)
+        with self._mu:
+            self.ram.apply_group_adam(*args, **kwargs)
+
+    def apply_group_adagrad(self, *args, **kwargs):
+        with self._mu:
+            self.ram.apply_group_adagrad(*args, **kwargs)
+
+    def apply_group_ftrl(self, *args, **kwargs):
+        with self._mu:
+            self.ram.apply_group_ftrl(*args, **kwargs)
+
+    def apply_group_lamb(self, *args, **kwargs):
+        with self._mu:
+            self.ram.apply_group_lamb(*args, **kwargs)
 
     def spill(self, min_step: int, min_count: int = 0) -> int:
         """Demote features colder than the thresholds to the disk tier."""
-        keys, rows, m, v, counts, steps = self.ram.export()
-        cold = [
-            i for i in range(keys.size)
-            if steps[i] < min_step and counts[i] < min_count
-        ]
-        for i in cold:
-            self.disk.append(
-                int(keys[i]), rows[i], m[i], v[i],
-                int(counts[i]), int(steps[i]),
-            )
-        if cold:
-            self.disk.flush()
-            # Destructive removal from RAM only AFTER the disk write.
-            self.ram.evict(min_step, min_count)
-        return len(cold)
+        with self._mu:
+            keys, rows, m, v, counts, steps = self.ram.export()
+            cold = [
+                i for i in range(keys.size)
+                if steps[i] < min_step and counts[i] < min_count
+            ]
+            for i in cold:
+                self.disk.append(
+                    int(keys[i]), rows[i], m[i], v[i],
+                    int(counts[i]), int(steps[i]),
+                )
+            if cold:
+                # Durable flush (fsync): the RAM removal below is destructive,
+                # so the spilled rows must be on stable storage first.
+                self.disk.flush(durable=True)
+                self.ram.evict(min_step, min_count)
+            return len(cold)
 
     def export(self, min_step: int = 0):
         """Export spans BOTH tiers with the same recency filter — a row
         touched inside the delta window may have been spilled since."""
-        ram = self.ram.export(min_step)
-        disk_hits = []
-        for key in self.disk.keys():
-            record = self.disk.read(key)
-            if record is None:
-                continue
-            if min_step and record[4] < min_step:
-                continue
-            disk_hits.append((key, *record))
-        if not disk_hits:
-            return ram
-        keys = list(ram[0]) + [h[0] for h in disk_hits]
-        rows = list(ram[1]) + [h[1] for h in disk_hits]
-        m = list(ram[2]) + [h[2] for h in disk_hits]
-        v = list(ram[3]) + [h[3] for h in disk_hits]
-        counts = list(ram[4]) + [h[4] for h in disk_hits]
-        steps = list(ram[5]) + [h[5] for h in disk_hits]
-        return (
-            np.asarray(keys, np.int64),
-            np.asarray(rows, np.float32).reshape(-1, self.dim),
-            np.asarray(m, np.float32).reshape(-1, self.dim),
-            np.asarray(v, np.float32).reshape(-1, self.dim),
-            np.asarray(counts, np.uint32),
-            np.asarray(steps, np.uint32),
-        )
+        with self._mu:
+            ram = self.ram.export(min_step)
+            disk_hits = []
+            for key in self.disk.keys():
+                record = self.disk.read(key)
+                if record is None:
+                    continue
+                if min_step and record[4] < min_step:
+                    continue
+                disk_hits.append((key, *record))
+            if not disk_hits:
+                return ram
+            keys = list(ram[0]) + [h[0] for h in disk_hits]
+            rows = list(ram[1]) + [h[1] for h in disk_hits]
+            m = list(ram[2]) + [h[2] for h in disk_hits]
+            v = list(ram[3]) + [h[3] for h in disk_hits]
+            counts = list(ram[4]) + [h[4] for h in disk_hits]
+            steps = list(ram[5]) + [h[5] for h in disk_hits]
+            return (
+                np.asarray(keys, np.int64),
+                np.asarray(rows, np.float32).reshape(-1, self.dim),
+                np.asarray(m, np.float32).reshape(-1, self.dim),
+                np.asarray(v, np.float32).reshape(-1, self.dim),
+                np.asarray(counts, np.uint32),
+                np.asarray(steps, np.uint32),
+            )
 
     def insert(self, keys, rows, m=None, v=None, counts=None, steps=None):
         """Import path: the RAM copy becomes authoritative — tombstone any
         disk copy or a later fault-in would clobber it with stale state."""
-        self.ram.insert(keys, rows, m, v, counts, steps)
-        for key in np.asarray(keys, np.int64).reshape(-1).tolist():
-            self.disk.remove(int(key))
-        self.disk.flush()
+        with self._mu:
+            self.ram.insert(keys, rows, m, v, counts, steps)
+            for key in np.asarray(keys, np.int64).reshape(-1).tolist():
+                self.disk.remove(int(key))
+            self.disk.flush()
 
     def evict(self, min_step: int, min_count: int = 0) -> int:
         """Destructive eviction across BOTH tiers."""
-        dropped = self.ram.evict(min_step, min_count)
-        for key in self.disk.keys():
-            record = self.disk.read(key)
-            if record and record[4] < min_step and record[3] < min_count:
-                self.disk.remove(key)
-                dropped += 1
-        return dropped
+        with self._mu:
+            dropped = self.ram.evict(min_step, min_count)
+            for key in self.disk.keys():
+                record = self.disk.read(key)
+                if record and record[4] < min_step and record[3] < min_count:
+                    self.disk.remove(key)
+                    dropped += 1
+            return dropped
 
     def compact(self):
-        self.disk.compact()
+        with self._mu:
+            self.disk.compact()
 
     def close(self):
-        self.disk.close()
-        self.ram.close()
+        with self._mu:
+            self.disk.close()
+            self.ram.close()

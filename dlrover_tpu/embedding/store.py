@@ -66,6 +66,17 @@ def _load_native() -> Optional[ctypes.CDLL]:
             c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float, c.c_float,
             c.c_float, c.c_float, i64,
         ]
+        lib.kv_apply_group_adagrad.argtypes = [
+            c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float,
+        ]
+        lib.kv_apply_group_ftrl.argtypes = [
+            c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float, c.c_float,
+            c.c_float,
+        ]
+        lib.kv_apply_group_lamb.argtypes = [
+            c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float, c.c_float,
+            c.c_float, c.c_float, i64,
+        ]
         lib.kv_export.restype = i64
         lib.kv_export.argtypes = [
             c.c_void_p, u32, i64p, f32p, f32p, f32p, u32p, u32p, i64,
@@ -83,7 +94,19 @@ def _ptr(arr: np.ndarray, ctype):
 
 
 class KVStore:
-    """Dynamic sparse table: int64 key -> (value, adam m/v, count, step)."""
+    """Dynamic sparse table: int64 key -> (value, optimizer s0/s1, count, step).
+
+    The two optimizer-state rows mean (m, v) under adam/lamb, (accumulator,
+    unused) under adagrad and (accumulator, linear) under ftrl — mirroring
+    the reference's group-sparse apply family
+    (``tfplus/kv_variable/ops/training_ops.cc``).
+
+    Thread safety: the C table is not internally synchronized and ctypes
+    calls release the GIL, so every native call (and the NumPy fallback,
+    for contract parity) is serialized behind a per-store lock — a
+    checkpoint thread exporting concurrently with a training lookup would
+    otherwise race ``grow()``.
+    """
 
     def __init__(self, dim: int, initial_capacity: int = 1024,
                  native: Optional[bool] = None):
@@ -92,25 +115,35 @@ class KVStore:
         if native is True and lib is None:
             raise RuntimeError("native kv_store requested but unavailable")
         self._lib = lib
+        self._mu = threading.Lock()
         if lib is not None:
             self._handle = lib.kv_create(self.dim, initial_capacity)
         else:
             self._py: Dict[int, np.ndarray] = {}
             self._py_meta: Dict[int, Tuple[int, int]] = {}  # count, step
 
+    def _h(self):
+        """Native handle, or a Python error (not a nullptr segfault) when a
+        thread calls in after close()."""
+        if self._handle is None:
+            raise RuntimeError("KVStore is closed")
+        return self._handle
+
     @property
     def native(self) -> bool:
         return self._lib is not None
 
     def __len__(self) -> int:
-        if self._lib:
-            return int(self._lib.kv_size(self._handle))
-        return len(self._py)
+        with self._mu:
+            if self._lib:
+                return int(self._lib.kv_size(self._h()))
+            return len(self._py)
 
     def close(self):
-        if self._lib is not None and self._handle:
-            self._lib.kv_free(self._handle)
-            self._handle = None
+        with self._mu:
+            if self._lib is not None and self._handle:
+                self._lib.kv_free(self._handle)
+                self._handle = None
 
     # -- core ops -------------------------------------------------------------
 
@@ -119,75 +152,168 @@ class KVStore:
         """Gather rows, inserting missing keys (deterministic init)."""
         keys = np.ascontiguousarray(keys, np.int64)
         out = np.empty((keys.size, self.dim), np.float32)
-        if self._lib:
-            self._lib.kv_lookup(
-                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
-                _ptr(out, ctypes.c_float), init_scale, seed, step,
-            )
+        with self._mu:
+            if self._lib:
+                self._lib.kv_lookup(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(out, ctypes.c_float), init_scale, seed, step,
+                )
+                return out
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    rng = np.random.default_rng(
+                        # two's-complement view: negative keys (incl.
+                        # INT64_MIN) must seed without overflow
+                        np.uint64(key & 0xFFFFFFFFFFFFFFFF)
+                        ^ np.uint64(seed)
+                    )
+                    row = np.zeros((3, self.dim), np.float32)
+                    row[0] = rng.uniform(
+                        -init_scale, init_scale, self.dim
+                    ).astype(np.float32)
+                    self._py[key] = row
+                    self._py_meta[key] = (0, 0)
+                out[i] = row[0]
+                count, _ = self._py_meta[key]
+                self._py_meta[key] = (count + 1, step)
             return out
-        for i, key in enumerate(keys.tolist()):
-            row = self._py.get(key)
-            if row is None:
-                rng = np.random.default_rng(np.uint64(key) ^ np.uint64(seed))
-                row = np.zeros((3, self.dim), np.float32)
-                row[0] = rng.uniform(
-                    -init_scale, init_scale, self.dim
-                ).astype(np.float32)
-                self._py[key] = row
-                self._py_meta[key] = (0, 0)
-            out[i] = row[0]
-            count, _ = self._py_meta[key]
-            self._py_meta[key] = (count + 1, step)
-        return out
 
     def peek(self, keys: np.ndarray) -> np.ndarray:
         """Read-only gather; missing keys yield zeros (eval path)."""
         keys = np.ascontiguousarray(keys, np.int64)
         out = np.zeros((keys.size, self.dim), np.float32)
-        if self._lib:
-            self._lib.kv_peek(
-                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
-                _ptr(out, ctypes.c_float),
-            )
+        with self._mu:
+            if self._lib:
+                self._lib.kv_peek(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(out, ctypes.c_float),
+                )
+                return out
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is not None:
+                    out[i] = row[0]
             return out
-        for i, key in enumerate(keys.tolist()):
-            row = self._py.get(key)
-            if row is not None:
-                out[i] = row[0]
-        return out
+
+    def _check_grads(self, keys, grads):
+        keys = np.ascontiguousarray(keys, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        assert grads.shape == (keys.size, self.dim)
+        return keys, grads
 
     def apply_group_adam(self, keys: np.ndarray, grads: np.ndarray,
                          lr: float, b1: float = 0.9, b2: float = 0.999,
                          eps: float = 1e-8, weight_decay: float = 0.0,
                          t: int = 1):
         """Sparse Adam on the touched rows (moments live in the store)."""
-        keys = np.ascontiguousarray(keys, np.int64)
-        grads = np.ascontiguousarray(grads, np.float32)
-        assert grads.shape == (keys.size, self.dim)
-        if self._lib:
-            self._lib.kv_apply_group_adam(
-                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
-                _ptr(grads, ctypes.c_float), lr, b1, b2, eps,
-                weight_decay, t,
-            )
-            return
-        scale = np.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
-        for i, key in enumerate(keys.tolist()):
-            row = self._py.get(key)
-            if row is None:
-                continue
-            g = grads[i] + weight_decay * row[0]
-            row[1] = b1 * row[1] + (1 - b1) * g
-            row[2] = b2 * row[2] + (1 - b2) * g * g
-            row[0] -= lr * scale * row[1] / (np.sqrt(row[2]) + eps)
+        keys, grads = self._check_grads(keys, grads)
+        with self._mu:
+            if self._lib:
+                self._lib.kv_apply_group_adam(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(grads, ctypes.c_float), lr, b1, b2, eps,
+                    weight_decay, t,
+                )
+                return
+            scale = np.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    continue
+                g = grads[i] + weight_decay * row[0]
+                row[1] = b1 * row[1] + (1 - b1) * g
+                row[2] = b2 * row[2] + (1 - b2) * g * g
+                row[0] -= lr * scale * row[1] / (np.sqrt(row[2]) + eps)
+
+    def apply_group_adagrad(self, keys: np.ndarray, grads: np.ndarray,
+                            lr: float, eps: float = 1e-10):
+        """Sparse Adagrad (s0 = accumulator); ref
+        ``KvVariableGroupSparseApplyAdagrad``."""
+        keys, grads = self._check_grads(keys, grads)
+        with self._mu:
+            if self._lib:
+                self._lib.kv_apply_group_adagrad(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(grads, ctypes.c_float), lr, eps,
+                )
+                return
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    continue
+                row[1] += grads[i] * grads[i]
+                row[0] -= lr * grads[i] / (np.sqrt(row[1]) + eps)
+
+    def apply_group_ftrl(self, keys: np.ndarray, grads: np.ndarray,
+                         lr: float, l1: float = 0.0, l2: float = 0.0,
+                         beta: float = 0.0):
+        """Sparse FTRL-proximal, TF FtrlV2 semantics (s0 = accumulator,
+        s1 = linear); ref ``KvVariableGroupSparseApplyFtrl``."""
+        keys, grads = self._check_grads(keys, grads)
+        with self._mu:
+            if self._lib:
+                self._lib.kv_apply_group_ftrl(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(grads, ctypes.c_float), lr, l1, l2, beta,
+                )
+                return
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    continue
+                g = grads[i]
+                acc_new = row[1] + g * g
+                sigma = (np.sqrt(acc_new) - np.sqrt(row[1])) / lr
+                row[2] += g - sigma * row[0]
+                row[1] = acc_new
+                quad = (beta + np.sqrt(acc_new)) / lr + 2.0 * l2
+                lin = row[2]
+                row[0] = np.where(
+                    np.abs(lin) > l1, (np.sign(lin) * l1 - lin) / quad, 0.0
+                ).astype(np.float32)
+
+    def apply_group_lamb(self, keys: np.ndarray, grads: np.ndarray,
+                         lr: float, b1: float = 0.9, b2: float = 0.999,
+                         eps: float = 1e-6, weight_decay: float = 0.0,
+                         t: int = 1):
+        """Sparse LAMB with a per-row trust ratio (s0 = m, s1 = v)."""
+        keys, grads = self._check_grads(keys, grads)
+        with self._mu:
+            if self._lib:
+                self._lib.kv_apply_group_lamb(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(grads, ctypes.c_float), lr, b1, b2, eps,
+                    weight_decay, t,
+                )
+                return
+            bias1 = 1.0 - b1 ** t
+            bias2 = 1.0 - b2 ** t
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    continue
+                g = grads[i]
+                row[1] = b1 * row[1] + (1 - b1) * g
+                row[2] = b2 * row[2] + (1 - b2) * g * g
+                u = (row[1] / bias1) / (np.sqrt(row[2] / bias2) + eps)
+                u = u + weight_decay * row[0]
+                w_norm = float(np.linalg.norm(row[0]))
+                u_norm = float(np.linalg.norm(u))
+                ratio = w_norm / u_norm if w_norm > 0 and u_norm > 0 else 1.0
+                row[0] -= lr * ratio * u
 
     # -- export / import / eviction -------------------------------------------
 
     def export(self, min_step: int = 0):
         """(keys, values, m, v, counts, steps); ``min_step`` selects the
         delta touched at/after that step (0 = full export)."""
+        with self._mu:
+            return self._export_locked(min_step)
+
+    def _export_locked(self, min_step: int):
         if self._lib:
-            cap = int(self._lib.kv_count_since(self._handle, min_step))
+            cap = int(self._lib.kv_count_since(self._h(), min_step))
             keys = np.empty(cap, np.int64)
             rows = np.empty((cap, self.dim), np.float32)
             m = np.empty((cap, self.dim), np.float32)
@@ -195,7 +321,7 @@ class KVStore:
             counts = np.empty(cap, np.uint32)
             steps = np.empty(cap, np.uint32)
             n = int(self._lib.kv_export(
-                self._handle, min_step, _ptr(keys, ctypes.c_int64),
+                self._h(), min_step, _ptr(keys, ctypes.c_int64),
                 _ptr(rows, ctypes.c_float), _ptr(m, ctypes.c_float),
                 _ptr(v, ctypes.c_float), _ptr(counts, ctypes.c_uint32),
                 _ptr(steps, ctypes.c_uint32), cap,
@@ -221,42 +347,48 @@ class KVStore:
     def insert(self, keys, rows, m=None, v=None, counts=None, steps=None):
         keys = np.ascontiguousarray(keys, np.int64)
         rows = np.ascontiguousarray(rows, np.float32)
-        if self._lib:
-            self._lib.kv_insert(
-                self._handle, _ptr(keys, ctypes.c_int64), keys.size,
-                _ptr(rows, ctypes.c_float),
-                _ptr(np.ascontiguousarray(m, np.float32), ctypes.c_float)
-                if m is not None else None,
-                _ptr(np.ascontiguousarray(v, np.float32), ctypes.c_float)
-                if v is not None else None,
-                _ptr(np.ascontiguousarray(counts, np.uint32), ctypes.c_uint32)
-                if counts is not None else None,
-                _ptr(np.ascontiguousarray(steps, np.uint32), ctypes.c_uint32)
-                if steps is not None else None,
-            )
-            return
-        for i, key in enumerate(keys.tolist()):
-            row = np.zeros((3, self.dim), np.float32)
-            row[0] = rows[i]
-            if m is not None:
-                row[1] = m[i]
-            if v is not None:
-                row[2] = v[i]
-            self._py[key] = row
-            self._py_meta[key] = (
-                int(counts[i]) if counts is not None else 0,
-                int(steps[i]) if steps is not None else 0,
-            )
+        with self._mu:
+            if self._lib:
+                self._lib.kv_insert(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(rows, ctypes.c_float),
+                    _ptr(np.ascontiguousarray(m, np.float32), ctypes.c_float)
+                    if m is not None else None,
+                    _ptr(np.ascontiguousarray(v, np.float32), ctypes.c_float)
+                    if v is not None else None,
+                    _ptr(np.ascontiguousarray(counts, np.uint32),
+                         ctypes.c_uint32)
+                    if counts is not None else None,
+                    _ptr(np.ascontiguousarray(steps, np.uint32),
+                         ctypes.c_uint32)
+                    if steps is not None else None,
+                )
+                return
+            for i, key in enumerate(keys.tolist()):
+                row = np.zeros((3, self.dim), np.float32)
+                row[0] = rows[i]
+                if m is not None:
+                    row[1] = m[i]
+                if v is not None:
+                    row[2] = v[i]
+                self._py[key] = row
+                self._py_meta[key] = (
+                    int(counts[i]) if counts is not None else 0,
+                    int(steps[i]) if steps is not None else 0,
+                )
 
     def evict(self, min_step: int, min_count: int = 0) -> int:
         """Drop stale, cold features; returns evicted count."""
-        if self._lib:
-            return int(self._lib.kv_evict(self._handle, min_step, min_count))
-        stale = [
-            k for k, (count, step) in self._py_meta.items()
-            if step < min_step and count < min_count
-        ]
-        for k in stale:
-            del self._py[k]
-            del self._py_meta[k]
-        return len(stale)
+        with self._mu:
+            if self._lib:
+                return int(
+                    self._lib.kv_evict(self._h(), min_step, min_count)
+                )
+            stale = [
+                k for k, (count, step) in self._py_meta.items()
+                if step < min_step and count < min_count
+            ]
+            for k in stale:
+                del self._py[k]
+                del self._py_meta[k]
+            return len(stale)

@@ -29,27 +29,42 @@ from dlrover_tpu.embedding.store import KVStore
 
 
 class EmbeddingTable:
+    #: group-sparse optimizers the store applies in-table (ref
+    #: ``tfplus/kv_variable/ops/training_ops.cc`` optimizer-op family)
+    OPTIMIZERS = ("adam", "adagrad", "ftrl", "lamb")
+
     def __init__(
         self,
         name: str,
         dim: int,
         init_scale: float = 0.01,
         seed: int = 0,
+        optimizer: str = "adam",
         learning_rate: float = 1e-3,
         b1: float = 0.9,
         b2: float = 0.999,
         eps: float = 1e-8,
         weight_decay: float = 0.0,
+        l1: float = 0.0,
+        l2: float = 0.0,
+        beta: float = 0.0,
         native: Optional[bool] = None,
         spill_path: Optional[str] = None,
     ):
+        if optimizer not in self.OPTIMIZERS:
+            raise ValueError(
+                f"optimizer must be one of {self.OPTIMIZERS}, got "
+                f"{optimizer!r}"
+            )
         self.name = name
         self.dim = dim
         self.init_scale = init_scale
         self.seed = seed
+        self.optimizer = optimizer
         self.learning_rate = learning_rate
         self.b1, self.b2, self.eps = b1, b2, eps
         self.weight_decay = weight_decay
+        self.l1, self.l2, self.beta = l1, l2, beta
         if spill_path:
             # Hybrid mem/disk tier (ref tfplus hybrid_embedding): cold
             # features demote to disk and fault back on access.
@@ -87,13 +102,31 @@ class EmbeddingTable:
         return rows, unique, inverse.astype(np.int32)
 
     def apply_gradients(self, unique_keys: np.ndarray, grad_rows) -> None:
-        """Group-sparse Adam on the rows ``lookup`` returned this step."""
+        """Group-sparse update on the rows ``lookup`` returned this step,
+        with the optimizer chosen at construction."""
         self._adam_t += 1
-        self.store.apply_group_adam(
-            unique_keys, np.asarray(grad_rows, np.float32),
-            lr=self.learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,
-            weight_decay=self.weight_decay, t=self._adam_t,
-        )
+        grads = np.asarray(grad_rows, np.float32)
+        if self.optimizer == "adam":
+            self.store.apply_group_adam(
+                unique_keys, grads,
+                lr=self.learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay, t=self._adam_t,
+            )
+        elif self.optimizer == "adagrad":
+            self.store.apply_group_adagrad(
+                unique_keys, grads, lr=self.learning_rate, eps=self.eps,
+            )
+        elif self.optimizer == "ftrl":
+            self.store.apply_group_ftrl(
+                unique_keys, grads, lr=self.learning_rate,
+                l1=self.l1, l2=self.l2, beta=self.beta,
+            )
+        else:  # lamb
+            self.store.apply_group_lamb(
+                unique_keys, grads,
+                lr=self.learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay, t=self._adam_t,
+            )
 
     def evict(self, max_age_steps: int, min_count: int = 1) -> int:
         """Drop features colder than ``min_count`` hits and older than

@@ -35,6 +35,10 @@ def parse_args():
     p.add_argument("--dim", type=int, default=16)
     p.add_argument("--hidden", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--sparse-optimizer", default="adam",
+                   choices=("adam", "adagrad", "ftrl", "lamb"),
+                   help="group-sparse optimizer applied in-table to the "
+                        "embedding rows (dense tower always uses adam)")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--ckpt-every", type=int, default=100)
     p.add_argument("--evict-every", type=int, default=0,
@@ -62,12 +66,16 @@ def main():
         return feats, label
 
     table = EmbeddingTable(
-        "wide_deep", dim=args.dim, learning_rate=args.lr, seed=1
+        "wide_deep", dim=args.dim, learning_rate=args.lr, seed=1,
+        optimizer=args.sparse_optimizer,
     )
     if args.checkpoint_dir:
         restored = table.restore(args.checkpoint_dir)
         if restored:
             logger.info("embedding table resumed at step %d", restored)
+    # Whether a restorable full export already exists in this run's chain:
+    # a resumed run sits on the restored full, a fresh run has none yet.
+    saved_full = bool(args.checkpoint_dir) and restored > 0
 
     def dense_init(key):
         k1, k2 = jax.random.split(key)
@@ -124,11 +132,11 @@ def main():
         if args.checkpoint_dir and (
             step % args.ckpt_every == 0 or step == args.steps
         ):
-            # Full export on the first save, cheap deltas after.
-            table.save(
-                args.checkpoint_dir, step=step,
-                delta=step != args.ckpt_every,
-            )
+            # Full export on the first save, cheap deltas after.  (Restore
+            # replays newest full + newer deltas, so without a full base
+            # the deltas would be unrestorable.)
+            table.save(args.checkpoint_dir, step=step, delta=saved_full)
+            saved_full = True
     elapsed = time.monotonic() - t0
     logger.info(
         "done: %d steps, %.1f examples/s, %d live features",

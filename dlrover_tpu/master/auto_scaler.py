@@ -26,6 +26,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.brain import Observation, RunningJobOptimizer
 from dlrover_tpu.master.metrics import MetricsCollector
 from dlrover_tpu.master.node_manager import NodeManager, NodeStatus
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
@@ -56,6 +57,8 @@ class JobAutoScaler:
         node_unit: int = 1,
         cooldown_s: float = 30.0,
         retire_hook: Optional[Callable[[int], None]] = None,
+        optimizer: Optional[RunningJobOptimizer] = None,
+        optimize_interval_s: float = 300.0,
     ):
         self.node_manager = node_manager
         self.speed_monitor = speed_monitor
@@ -68,6 +71,12 @@ class JobAutoScaler:
         # rendezvous eviction + shard requeue here so survivors see the
         # broken world and re-form instead of hanging in dead collectives.
         self.retire_hook = retire_hook
+        # Observation-driven sizing (ref _periodic_optimize_running_resource):
+        # None disables; the repair/target-tracking loop still runs.
+        self.optimizer = optimizer
+        self.optimize_interval_s = optimize_interval_s
+        # First optimize only after a full interval of observations.
+        self._last_optimize = time.monotonic()
         self._target = max_nodes
         self._last_scale = 0.0
         self._lock = threading.Lock()
@@ -117,8 +126,43 @@ class JobAutoScaler:
             plan.reason = f"live {len(live)} > target {target}"
         return plan
 
+    def observe_and_optimize(self) -> None:
+        """Feed the running-job optimizer and move the target from its
+        recommendation — the observation-driven half of the scaler (ref
+        ``job_auto_scaler.py:161`` periodic optimize; no ``set_target``
+        call from any operator involved)."""
+        if self.optimizer is None:
+            return
+        now = time.monotonic()
+        statuses = self.node_manager.statuses()
+        live = sum(
+            1 for s in statuses.values() if s == NodeStatus.RUNNING.value
+        )
+        speed = self.speed_monitor.running_speed()
+        if live > 0 and speed > 0:
+            self.optimizer.observe(
+                Observation(
+                    num_nodes=live, speed=speed,
+                    goodput=self.speed_monitor.goodput(),
+                )
+            )
+        if now - self._last_optimize < self.optimize_interval_s:
+            return
+        self._last_optimize = now
+        if live == 0 or live != self.target:
+            # A repair or an in-flight resize is converging: sizing off a
+            # transiently-shrunk world would cancel the repair.
+            return
+        plan = self.optimizer.recommend(
+            current_nodes=live, min_nodes=self.min_nodes,
+            max_nodes=self.max_nodes, node_unit=self.node_unit,
+        )
+        if plan.num_nodes != self.target:
+            self.set_target(plan.num_nodes, reason=f"brain: {plan.reason}")
+
     def step(self) -> Optional[ScalePlan]:
         """One control-loop tick: decide and actuate (cooldown-limited)."""
+        self.observe_and_optimize()
         now = time.monotonic()
         if now - self._last_scale < self.cooldown_s:
             return None
@@ -137,4 +181,8 @@ class JobAutoScaler:
             self.node_manager.retire_node(node_id)
             if self.retire_hook is not None:
                 self.retire_hook(node_id)
+        # The gap until the re-formed world's first step report is downtime,
+        # and speed samples must not straddle the resize (the optimizer
+        # would attribute the old world's speed to the new size).
+        self.speed_monitor.reset_running_speed()
         return plan

@@ -135,6 +135,155 @@ class BrainService:
         )
 
 
+@dataclasses.dataclass
+class Observation:
+    """One live sample of the running job (ref ``job_auto_scaler.py``'s
+    periodic stats gather)."""
+
+    num_nodes: int
+    speed: float          # steps/sec (or any monotone throughput measure)
+    goodput: float = 1.0
+    timestamp: float = 0.0
+
+
+class RunningJobOptimizer:
+    """Observation-driven scaling recommendations for the RUNNING job.
+
+    Capability ref:
+    ``dlrover/python/master/node/job_auto_scaler.py:161-252``
+    (``_periodic_optimize_running_resource``) +
+    ``master/resource/local_optimizer.py:66-397``: derive resource plans
+    from the observed throughput history on a timer, no operator input.
+
+    Policy (slice-granular, node_unit-aligned):
+
+    * **explore up** — while the ceiling is untested, try one unit more;
+      sync SPMD throughput should scale near-linearly over ICI, and the
+      observation at the larger world either confirms (keep) or refutes
+      (come back down) the step.
+    * **retreat** — if the larger world's measured total throughput is NOT
+      at least ``uplift_threshold`` better than the best smaller world,
+      the extra unit is wasted resource: recommend the smaller world.
+    * **degraded** — if the current world's recent speed has fallen below
+      ``degrade_threshold`` x its own historical best for ``patience``
+      consecutive observations, recommend the best historical
+      configuration (which may equal the current size — the caller then
+      treats it as a world-health problem, not a sizing problem).
+
+    Pure function of the observation history: fully unit-testable with
+    synthetic speeds, no cluster required.
+    """
+
+    HISTORY = 64
+
+    def __init__(
+        self,
+        uplift_threshold: float = 1.1,
+        degrade_threshold: float = 0.7,
+        patience: int = 3,
+    ):
+        self.uplift_threshold = uplift_threshold
+        self.degrade_threshold = degrade_threshold
+        self.patience = patience
+        self._obs: Dict[int, List[Observation]] = {}
+        self._degraded_ticks = 0
+
+    def observe(self, obs: Observation):
+        if obs.speed <= 0:
+            return  # warmup/restart gaps carry no sizing signal
+        obs.timestamp = obs.timestamp or time.time()
+        hist = self._obs.setdefault(obs.num_nodes, [])
+        hist.append(obs)
+        del hist[: -self.HISTORY]
+        # Degradation is tracked per OBSERVATION (not per recommend() call,
+        # which runs on a much slower cadence): consecutive readings below
+        # threshold x the best seen at this size.
+        best = self._best_speed(obs.num_nodes)
+        if best > 0 and obs.speed < self.degrade_threshold * best:
+            self._degraded_ticks += 1
+        else:
+            self._degraded_ticks = 0
+
+    def _best_speed(self, num_nodes: int) -> float:
+        hist = self._obs.get(num_nodes, [])
+        return max((o.speed for o in hist), default=0.0)
+
+    def _recent_speed(self, num_nodes: int, k: int = 3) -> float:
+        hist = self._obs.get(num_nodes, [])
+        recent = hist[-k:]
+        return sum(o.speed for o in recent) / len(recent) if recent else 0.0
+
+    def recommend(
+        self,
+        current_nodes: int,
+        min_nodes: int,
+        max_nodes: int,
+        node_unit: int = 1,
+    ) -> ResourcePlan:
+        """Target world size from the observation history alone."""
+        unit = max(1, node_unit)
+        cur_best = self._best_speed(current_nodes)
+        cur_recent = self._recent_speed(current_nodes)
+
+        # Degradation watch (counter maintained in observe()).
+        if self._degraded_ticks >= self.patience:
+            sized = {
+                n: self._best_speed(n)
+                for n in self._obs if min_nodes <= n <= max_nodes
+            }
+            best_n = max(sized, key=lambda n: sized[n])
+            return ResourcePlan(
+                num_nodes=best_n,
+                global_batch_size=0,
+                reason=(
+                    f"degraded: recent {cur_recent:.2f} < "
+                    f"{self.degrade_threshold} x best {cur_best:.2f} at "
+                    f"{current_nodes} nodes for {self._degraded_ticks} obs"
+                ),
+                confidence=0.9,
+            )
+
+        larger = current_nodes + unit
+        smaller = current_nodes - unit
+        # Retreat: the step up did not pay for itself.  Gated on having at
+        # least `patience` samples at the current size — the first readings
+        # after an explore step are contaminated by the re-form/restore
+        # warmup, and an ungated retreat would permanently lock the job
+        # out of the larger world (explore never revisits a tested size).
+        if smaller >= min_nodes and self._best_speed(smaller) > 0 and (
+            len(self._obs.get(current_nodes, [])) >= self.patience
+        ) and (
+            cur_best < self.uplift_threshold * self._best_speed(smaller)
+        ):
+            return ResourcePlan(
+                num_nodes=smaller,
+                global_batch_size=0,
+                reason=(
+                    f"{current_nodes} nodes give {cur_best:.2f} <= "
+                    f"{self.uplift_threshold} x {self._best_speed(smaller):.2f} "
+                    f"at {smaller}: extra unit is wasted"
+                ),
+                confidence=0.8,
+            )
+        # Explore: the ceiling is untested and we have a stable reading here.
+        if larger <= max_nodes and len(self._obs.get(current_nodes, [])) >= (
+            self.patience
+        ) and self._best_speed(larger) == 0:
+            return ResourcePlan(
+                num_nodes=larger,
+                global_batch_size=0,
+                reason=f"exploring {larger} nodes (untested, ceiling "
+                       f"{max_nodes})",
+                confidence=0.5,
+            )
+        return ResourcePlan(
+            num_nodes=current_nodes,
+            global_batch_size=0,
+            reason="current size is the best known configuration",
+            confidence=0.6,
+        )
+
+
 def record_job(
     brain: BrainService,
     job_name: str,

@@ -42,6 +42,7 @@ class JobMaster:
         heartbeat_timeout: float = 0.0,
         hang_threshold: float = 0.0,
         auto_scale: bool = True,
+        optimize_interval_s: float = 300.0,
         state_path: str = "",
     ):
         self.speed_monitor = SpeedMonitor()
@@ -54,6 +55,8 @@ class JobMaster:
             max_relaunches=max_relaunches,
             heartbeat_timeout=heartbeat_timeout,
         )
+        from dlrover_tpu.master.brain import RunningJobOptimizer
+
         self.auto_scaler = JobAutoScaler(
             self.node_manager,
             self.speed_monitor,
@@ -62,6 +65,11 @@ class JobMaster:
             max_nodes=num_nodes,
             node_unit=node_unit,
             retire_hook=self._handle_node_retired,
+            # Observation-driven sizing only makes sense with an elastic
+            # range; a fixed-size job gets the repair loop alone.
+            optimizer=RunningJobOptimizer()
+            if (min_nodes and min_nodes < num_nodes) else None,
+            optimize_interval_s=optimize_interval_s,
         ) if auto_scale else None
         # Hang remediation (ref CheckTrainingHangOperator +
         # atorch HangingDetector): 0 disables.
@@ -91,6 +99,13 @@ class JobMaster:
             RendezvousName.TRAINING: elastic,
             RendezvousName.NETWORK_CHECK: netcheck,
         }
+        # Creation-failure backchannel: a launcher that gives up on a VM
+        # create (stockout after retries) must surface as a node failure,
+        # or the node sits PENDING forever (PENDING never heartbeat-times-
+        # out and counts as live in the scaler).
+        if launcher is not None and hasattr(launcher, "node_failed_hook") \
+                and launcher.node_failed_hook is None:
+            launcher.node_failed_hook = self._handle_launch_failed
         self.servicer = MasterServicer(
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
@@ -127,6 +142,7 @@ class JobMaster:
                 newly_dead = self.node_manager.check_heartbeats()
                 for node_id in newly_dead:
                     self._handle_node_death(node_id)
+                self._reconcile_cloud()
                 self.task_manager.reassign_timeout_tasks()
                 if self.auto_scaler is not None:
                     self.auto_scaler.step()
@@ -136,6 +152,53 @@ class JobMaster:
             except Exception as e:
                 logger.warning("master control loop error: %s", e)
             self._stop.wait(self.CONTROL_LOOP_INTERVAL)
+
+    def _handle_launch_failed(self, node_id: int, reason: str):
+        """The launcher exhausted its create retries: count it against the
+        node's relaunch budget (repeated stockouts eventually fail the job
+        instead of wedging the rendezvous on a phantom PENDING node)."""
+        logger.error("node %d VM creation failed: %s", node_id, reason)
+        self.node_manager.report_event(
+            node_id, "failed", f"vm create: {reason}"
+        )
+
+    def bootstrap_nodes(self):
+        """Create the initial inventory through the launcher (cloud jobs —
+        the reference's operator creates the first pods on job submit;
+        standalone local mode never calls this: the launching host IS the
+        first node and ``run.py`` spawns the rest)."""
+        for node_id in sorted(self.node_manager.statuses()):
+            self.node_manager.launch_node(node_id, bootstrap=True)
+
+    def _reconcile_cloud(self):
+        """Map cloud VM states onto the inventory (the reference's pod
+        Watcher role, as a poll — ``pod_watcher.py`` equivalent): a
+        PREEMPTED/TERMINATED VM behind a node the master still thinks is
+        alive gets the node-death treatment without waiting out the
+        heartbeat timeout."""
+        launcher = getattr(self.node_manager, "_launcher", None)
+        reconcile = getattr(launcher, "reconcile", None)
+        if reconcile is None:
+            return
+        from dlrover_tpu.master.cloud_launcher import TpuVmState
+        from dlrover_tpu.master.node_manager import NodeStatus
+
+        statuses = self.node_manager.statuses()
+        for node_id, vm_state in reconcile().items():
+            if vm_state in (TpuVmState.PREEMPTED, TpuVmState.TERMINATED):
+                # RUNNING only: a PENDING node's dead VM is the one we just
+                # replaced — real-cloud deletes are async and the stale VM
+                # lingers in list() for several ticks; re-failing it every
+                # tick would burn the whole relaunch budget on one
+                # preemption.
+                if statuses.get(node_id) == NodeStatus.RUNNING.value:
+                    logger.warning(
+                        "cloud reconcile: node %d VM is %s", node_id, vm_state
+                    )
+                    self.node_manager.report_event(
+                        node_id, "failed", f"vm {vm_state}"
+                    )
+                    self._handle_node_death(node_id)
 
     def _run_diagnosis(self):
         """One inference-chain pass; execute what it prescribes (ref

@@ -228,8 +228,12 @@ class NodeManager:
             node = self._nodes.get(node_id)
             return node is None or node.relaunch_count < node.max_relaunches
 
-    def launch_node(self, node_id: int) -> bool:
+    def launch_node(self, node_id: int, bootstrap: bool = False) -> bool:
         """Scaler entry: (re)launch a host if its relaunch budget remains.
+
+        ``bootstrap=True`` is the initial-creation path (the reference's
+        operator creating the job's first pods): it launches a
+        never-started PENDING node without consuming relaunch budget.
 
         The launcher call itself runs OUTSIDE the lock — a real launcher
         (cloud API, subprocess teardown) can block for seconds and every
@@ -237,14 +241,17 @@ class NodeManager:
         """
         with self._lock:
             node = self.ensure_node(node_id)
-            if node.status in (NodeStatus.RUNNING, NodeStatus.PENDING):
+            if node.status == NodeStatus.RUNNING or (
+                node.status == NodeStatus.PENDING and not bootstrap
+            ):
                 return True
-            if node.relaunch_count >= node.max_relaunches:
+            if not bootstrap and node.relaunch_count >= node.max_relaunches:
                 logger.warning(
                     "node %d relaunch budget exhausted", node_id
                 )
                 return False
-            node.relaunch_count += 1
+            if not bootstrap:
+                node.relaunch_count += 1
             node.last_heartbeat = time.time()
             self._transition(node, NodeStatus.PENDING)
         try:

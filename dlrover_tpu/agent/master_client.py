@@ -160,8 +160,11 @@ class MasterClient:
 
     # -- telemetry / lifecycle ------------------------------------------------
 
-    def report_step(self, step: int, tokens: int = 0, loss: float = 0.0):
-        self.report(msg.StepReport(step, tokens=tokens, loss=loss))
+    def report_step(self, step: int, tokens: int = 0, loss: float = 0.0,
+                    anomalies: tuple = ()):
+        self.report(msg.StepReport(
+            step, tokens=tokens, loss=loss, anomalies=tuple(anomalies),
+        ))
 
     def report_heartbeat(self, diagnosis: Optional[Dict] = None):
         self.report(msg.HeartBeat(self.node_id, diagnosis=diagnosis or {}))

@@ -1,0 +1,79 @@
+"""Trainer-side text-file shard reader: line ranges without top scans.
+
+Capability ref: ``dlrover/python/master/shard/dataset_splitter.py:257``
+(TextDatasetSplitter) and the text reading path of the reference's elastic
+dataset — the master hands out [start, end) LINE ranges
+(``TextDatasetSplitter`` in master/task_manager.py); this reader turns
+them into lines in O(shard) time via a byte-offset index built once per
+file (one sequential pass, cached on disk next to the file so restarts
+and sibling workers skip the rebuild).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class TextShardReader:
+    """Random access to line ranges of a (potentially large) text file."""
+
+    INDEX_SUFFIX = ".lineidx.npy"
+
+    def __init__(self, path: str, index_path: Optional[str] = None):
+        self.path = path
+        self._index_path = index_path or (path + self.INDEX_SUFFIX)
+        self._offsets = self._load_or_build_index()
+        self._file = open(path, "rb")
+
+    @property
+    def num_lines(self) -> int:
+        return len(self._offsets) - 1
+
+    def _load_or_build_index(self) -> np.ndarray:
+        """offsets[i] = byte offset of line i; offsets[-1] = file size."""
+        fsize = os.path.getsize(self.path)
+        if os.path.exists(self._index_path):
+            try:
+                offsets = np.load(self._index_path)
+                # The index is only valid for the file it was built from.
+                if offsets.ndim == 1 and offsets.size >= 1 and (
+                    int(offsets[-1]) == fsize
+                ):
+                    return offsets
+                logger.warning(
+                    "text index %s is stale (file size changed); rebuilding",
+                    self._index_path,
+                )
+            except (OSError, ValueError):
+                pass
+        offsets = [0]
+        with open(self.path, "rb") as f:
+            for line in f:
+                offsets.append(offsets[-1] + len(line))
+        arr = np.asarray(offsets, np.int64)
+        try:
+            tmp = self._index_path + f".tmp{os.getpid()}"
+            np.save(tmp, arr)
+            os.replace(tmp + ".npy" if not tmp.endswith(".npy") else tmp,
+                       self._index_path)
+        except OSError as e:
+            logger.warning("could not cache text index: %s", e)
+        return arr
+
+    def read_shard(self, start: int, end: int) -> List[str]:
+        """Lines [start, end) (newline-stripped); clamps to file length."""
+        start = max(0, start)
+        end = min(end, self.num_lines)
+        if start >= end:
+            return []
+        self._file.seek(int(self._offsets[start]))
+        blob = self._file.read(int(self._offsets[end] - self._offsets[start]))
+        return blob.decode("utf-8", errors="replace").splitlines()
+
+    def close(self):
+        self._file.close()

@@ -139,7 +139,11 @@ class JobAutoScaler:
             1 for s in statuses.values() if s == NodeStatus.RUNNING.value
         )
         speed = self.speed_monitor.running_speed()
-        if live > 0 and speed > 0:
+        # Observations are recorded only for a STEADY world (live == target):
+        # right after a death/resize the speed window can still span the old
+        # world, and attributing its throughput to the new size poisons the
+        # per-size history (retreat would then permanently shrink the job).
+        if live > 0 and speed > 0 and live == self.target:
             self.optimizer.observe(
                 Observation(
                     num_nodes=live, speed=speed,
@@ -159,6 +163,12 @@ class JobAutoScaler:
         )
         if plan.num_nodes != self.target:
             self.set_target(plan.num_nodes, reason=f"brain: {plan.reason}")
+        elif "degraded" in plan.reason:
+            # Same-size degradation is a world-HEALTH problem, not a sizing
+            # problem: surface it loudly so the operator (or the diagnosis
+            # chain reading the log/metrics) can act — silence here would
+            # let the job limp at a fraction of its proven speed forever.
+            logger.warning("brain health: %s", plan.reason)
 
     def step(self) -> Optional[ScalePlan]:
         """One control-loop tick: decide and actuate (cooldown-limited)."""

@@ -231,17 +231,24 @@ class RunningJobOptimizer:
                 n: self._best_speed(n)
                 for n in self._obs if min_nodes <= n <= max_nodes
             }
-            best_n = max(sized, key=lambda n: sized[n])
-            return ResourcePlan(
-                num_nodes=best_n,
-                global_batch_size=0,
-                reason=(
-                    f"degraded: recent {cur_recent:.2f} < "
-                    f"{self.degrade_threshold} x best {cur_best:.2f} at "
-                    f"{current_nodes} nodes for {self._degraded_ticks} obs"
-                ),
-                confidence=0.9,
-            )
+            if sized:
+                ticks = self._degraded_ticks
+                # One plan per sustained episode: continued degradation
+                # re-accumulates the counter from fresh observations.
+                self._degraded_ticks = 0
+                best_n = max(sized, key=lambda n: sized[n])
+                return ResourcePlan(
+                    num_nodes=best_n,
+                    global_batch_size=0,
+                    reason=(
+                        f"degraded: recent {cur_recent:.2f} < "
+                        f"{self.degrade_threshold} x best {cur_best:.2f} at "
+                        f"{current_nodes} nodes for {ticks} obs"
+                    ),
+                    confidence=0.9,
+                )
+            # No in-range history to recommend from: fall through to the
+            # sizing rules instead of crashing on an empty argmax.
 
         larger = current_nodes + unit
         smaller = current_nodes - unit

@@ -171,6 +171,11 @@ class CloudNodeLauncher(NodeLauncher):
         self.runtime_version = runtime_version
         self.node_failed_hook = node_failed_hook
         self._queue: "queue.Queue[int]" = queue.Queue()
+        # Nodes the job currently wants alive: delete() retracts a node so
+        # a still-queued create for it is dropped instead of leaking an
+        # orphan VM (retire racing the creator thread).
+        self._wanted: set = set()
+        self._wanted_mu = threading.Lock()
         self._stop = threading.Event()
         self._creator = threading.Thread(
             target=self._create_loop, name="tpu-vm-creator", daemon=True
@@ -194,9 +199,13 @@ class CloudNodeLauncher(NodeLauncher):
     # -- NodeLauncher ------------------------------------------------------
 
     def launch(self, node_id: int) -> None:
+        with self._wanted_mu:
+            self._wanted.add(node_id)
         self._queue.put(node_id)
 
     def delete(self, node_id: int) -> None:
+        with self._wanted_mu:
+            self._wanted.discard(node_id)
         name = self.instance_name(node_id)
         try:
             self.client.delete_node(name)
@@ -217,29 +226,37 @@ class CloudNodeLauncher(NodeLauncher):
                 node_id = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
+            with self._wanted_mu:
+                if node_id not in self._wanted:
+                    # Retired while queued: creating now would orphan a VM.
+                    logger.info(
+                        "cloud launcher: dropping queued create for "
+                        "retired node %d", node_id,
+                    )
+                    continue
             self._create_with_retry(node_id)
 
     def _create_with_retry(self, node_id: int):
         name = self.instance_name(node_id)
-        existing = self.client.get_node(name)
-        if existing is not None and existing["state"] in (
-            TpuVmState.CREATING, TpuVmState.READY
-        ):
-            logger.info("cloud launcher: %s already %s", name,
-                        existing["state"])
-            return
         last_err: Optional[CloudError] = None
         for attempt in range(self.CREATE_RETRIES):
-            if existing is not None and (
-                existing["state"] in (TpuVmState.PREEMPTED,
-                                      TpuVmState.TERMINATED)
+            existing = self.client.get_node(name)
+            if existing is not None and existing["state"] in (
+                TpuVmState.CREATING, TpuVmState.READY
             ):
-                # A dead VM holds the name on some surfaces: clear it first.
+                # Includes the partial-failure case: a create that errored
+                # client-side but landed server-side IS a success — never
+                # report a healthy VM as failed.
+                logger.info("cloud launcher: %s already %s", name,
+                            existing["state"])
+                return
+            if existing is not None:
+                # A dead VM (PREEMPTED/TERMINATED) holds the name on some
+                # surfaces: clear it first.
                 try:
                     self.client.delete_node(name)
                 except CloudError:
                     pass
-                existing = None
             try:
                 self.client.create_node(
                     name,
@@ -262,7 +279,12 @@ class CloudNodeLauncher(NodeLauncher):
                 )
                 if self._stop.wait(self.RETRY_BACKOFF_S * (attempt + 1)):
                     return
-                existing = self.client.get_node(name)
+        # One final state check: the last attempt may have landed.
+        existing = self.client.get_node(name)
+        if existing is not None and existing["state"] in (
+            TpuVmState.CREATING, TpuVmState.READY
+        ):
+            return
         logger.error("cloud launcher: giving up on %s (%s)", name, last_err)
         if self.node_failed_hook is not None:
             self.node_failed_hook(node_id, str(last_err))

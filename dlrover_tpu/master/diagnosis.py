@@ -131,6 +131,60 @@ class NodeFlappingOperator(InferenceOperator):
         return out
 
 
+class NumericAnomalyOperator(InferenceOperator):
+    """Numeric-health input to the chain (ref ``loss_spike_utils.py`` +
+    ``numberic_checker.py``, which the reference leaves as offline tools —
+    here the signal closes the loop):
+
+    * a reported **nan** poisons every replica of the state — restarting
+      the world restores the last good checkpoint (severity above a hang:
+      continuing to step a NaN'd model productively burns the job);
+    * sustained **loss_spike** / **grad_explosion** reports are surfaced
+      (an operator decision: could be bad data or an LR cliff — automatic
+      rollback of a *finite* divergence is a policy call, not a reflex).
+    """
+
+    name = "numeric_anomaly"
+    SPIKE_REPORT_THRESHOLD = 2  # distinct spike reports inside the window
+
+    def __init__(self):
+        # A stale NaN report must trigger ONE restart, not one per
+        # cooldown until it ages out of the window.
+        self._consumed_ts = 0.0
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        sm = ctx.speed_monitor
+        recent = getattr(sm, "recent_anomalies", lambda: [])()
+        if not recent:
+            return []
+        out: List[DiagnosisAction] = []
+        nans = [
+            a for a in recent
+            if a[2].startswith("nan@") and a[0] > self._consumed_ts
+        ]
+        if nans:
+            self._consumed_ts = nans[-1][0]
+            out.append(DiagnosisAction(
+                ActionType.RESTART_WORLD,
+                reason=(
+                    f"non-finite training state reported: {nans[-1][2]} — "
+                    "restoring last good checkpoint"
+                ),
+                severity=3,
+            ))
+        spikes = [a for a in recent if not a[2].startswith("nan@")]
+        if len(spikes) >= self.SPIKE_REPORT_THRESHOLD:
+            out.append(DiagnosisAction(
+                ActionType.REPORT,
+                reason=(
+                    f"{len(spikes)} numeric anomalies in window "
+                    f"(latest: {spikes[-1][2]})"
+                ),
+                severity=1,
+            ))
+        return out
+
+
 class InferenceChain:
     """Run the operators, combine evidence, rank the produced actions.
 
@@ -145,6 +199,7 @@ class InferenceChain:
             TrainingHangOperator(),
             ResourceStallOperator(),
             NodeFlappingOperator(),
+            NumericAnomalyOperator(),
         ]
 
     def infer(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:

@@ -49,6 +49,7 @@ class JobMaster:
         self.task_manager = TaskManager()
         self.kv_store = KVStore()
         self.metrics = MetricsCollector()
+        self._launcher = launcher
         self.node_manager = NodeManager(
             num_nodes=num_nodes,
             launcher=launcher,
@@ -176,8 +177,7 @@ class JobMaster:
         PREEMPTED/TERMINATED VM behind a node the master still thinks is
         alive gets the node-death treatment without waiting out the
         heartbeat timeout."""
-        launcher = getattr(self.node_manager, "_launcher", None)
-        reconcile = getattr(launcher, "reconcile", None)
+        reconcile = getattr(self._launcher, "reconcile", None)
         if reconcile is None:
             return
         from dlrover_tpu.master.cloud_launcher import TpuVmState

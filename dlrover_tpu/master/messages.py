@@ -172,6 +172,9 @@ class StepReport:
     samples: int = 0
     tokens: int = 0
     loss: float = 0.0
+    # Encoded numeric anomalies observed at/since the last report
+    # (trainer/numeric_health.py): e.g. "nan@120:loss=nan grad_norm=12.3".
+    anomalies: tuple = ()
 
 
 @dataclasses.dataclass

@@ -184,6 +184,8 @@ class MasterServicer:
     def _report_step(self, env: msg.Envelope):
         p: msg.StepReport = env.payload
         self.speed_monitor.collect_global_step(p.step, p.timestamp, p.tokens)
+        for encoded in getattr(p, "anomalies", ()):
+            self.speed_monitor.record_anomaly(p.step, str(encoded))
 
     def _report_heartbeat(self, env: msg.Envelope):
         p: msg.HeartBeat = env.payload

@@ -28,6 +28,8 @@ class SpeedMonitor:
         self._productive_s = 0.0
         self._last_step_time: Optional[float] = None
         self._first_step_time: Optional[float] = None
+        # (ts, step, encoded) numeric anomalies from trainers.
+        self._anomalies: Deque[Tuple[float, int, str]] = deque(maxlen=256)
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -48,6 +50,18 @@ class SpeedMonitor:
             self._global_step = step
             self._tokens_cum += tokens
             self._samples.append((ts, step, self._tokens_cum))
+
+    def record_anomaly(self, step: int, encoded: str):
+        """Numeric anomaly reported by a trainer (kind@step:detail); feeds
+        the NumericAnomalyOperator in the diagnosis chain."""
+        with self._lock:
+            self._anomalies.append((time.time(), step, encoded))
+
+    def recent_anomalies(self, window_s: float = 600.0):
+        """[(ts, step, encoded)] within the window, oldest first."""
+        cutoff = time.time() - window_s
+        with self._lock:
+            return [a for a in self._anomalies if a[0] >= cutoff]
 
     def reset_running_speed(self):
         """Call on restart: the gap until the next step report is downtime."""

@@ -65,6 +65,26 @@ class DatasetSplitter:
         return self.epoch >= self.params.num_epochs
 
 
+class TableDatasetSplitter(DatasetSplitter):
+    """Record-range shards over a bounded table (capability ref
+    ``dataset_splitter.py:144`` TableDatasetSplitter): shards are [start,
+    end) row ranges, epochs reshuffle the shard ORDER (never the rows
+    inside a shard — a shard is the reader's sequential-scan unit)."""
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Line-range shards over a text file (capability ref
+    ``dataset_splitter.py:257`` TextDatasetSplitter): ``dataset_size`` is
+    the line count and a shard is a [start, end) line range.  The
+    trainer-side :class:`dlrover_tpu.data.text_shards.TextShardReader`
+    turns a shard into its lines via a byte-offset index, so workers never
+    scan the file from the top.
+
+    Same range arithmetic as the table splitter — the split is identical,
+    the read path differs — but sharding is capped to whole lines so a
+    short final shard is emitted rather than padding past EOF."""
+
+
 class StreamingDatasetSplitter(DatasetSplitter):
     """Unbounded stream: keeps emitting fixed-size shards forever
     (capability ref ``dataset_splitter.py:359`` StreamingDatasetSplitter)."""
@@ -93,9 +113,12 @@ class StreamingDatasetSplitter(DatasetSplitter):
 
 
 def make_splitter(params: DatasetShardParams) -> DatasetSplitter:
+    """ref ``dataset_splitter.py``'s factory: table | text | stream."""
     if params.storage_type == "stream":
         return StreamingDatasetSplitter(params)
-    return DatasetSplitter(params)
+    if params.storage_type == "text":
+        return TextDatasetSplitter(params)
+    return TableDatasetSplitter(params)
 
 
 class DatasetManager:

@@ -40,11 +40,47 @@ class TrainerConfig:
     seq_len: int = 128
     optimizer: str = "adamw"
     learning_rate: float = 1e-3
+    # LR schedule (train_lib.make_schedule): warmup-linear, then cosine
+    # decay when decay_steps > 0.
+    warmup_steps: int = 0
+    decay_steps: int = 0
     checkpoint_dir: str = ""
     ckpt_every: int = 100
     report_every: int = 5
+    # Evaluation cadence: 0 disables periodic eval during fit().
+    eval_every: int = 0
+    eval_batches: int = 10
     auto_tune: bool = False
     ce_chunks: int = 0
+    # Numeric health (trainer/numeric_health.py): anomalies ship to the
+    # master with step reports, feeding the NumericAnomalyOperator.
+    numeric_checks: bool = True
+
+
+class TrainerCallback:
+    """Hook surface of the fit loop (ref ``atorch_trainer.py`` callbacks /
+    the HF TrainerCallback contract it implements).  Subclass and override;
+    every method is optional."""
+
+    def on_train_begin(self, trainer: "ElasticTrainer"):
+        pass
+
+    def on_step_end(self, trainer: "ElasticTrainer", step: int,
+                    metrics: Dict[str, Any]):
+        pass
+
+    def on_evaluate(self, trainer: "ElasticTrainer", step: int,
+                    eval_metrics: Dict[str, float]):
+        pass
+
+    def on_checkpoint(self, trainer: "ElasticTrainer", step: int):
+        pass
+
+    def on_epoch_end(self, trainer: "ElasticTrainer", epoch: int):
+        pass
+
+    def on_train_end(self, trainer: "ElasticTrainer", step: int):
+        pass
 
 
 class ElasticTrainer:
@@ -64,8 +100,10 @@ class ElasticTrainer:
         rules=None,
         optimizer: Optional[optax.GradientTransformation] = None,
         client=None,
+        callbacks=None,
     ):
         self.config = config
+        self.callbacks = list(callbacks or [])
         self.client = client if client is not None else renv.master_client()
         if config.auto_tune:
             from dlrover_tpu.auto import auto_tune
@@ -85,8 +123,21 @@ class ElasticTrainer:
         self.mesh = build_mesh(self.parallel)
         self.model = TransformerLM(model_config)
         self.optimizer = optimizer or train_lib.make_optimizer(
-            config.optimizer, learning_rate=config.learning_rate
+            config.optimizer, learning_rate=config.learning_rate,
+            warmup_steps=config.warmup_steps,
+            decay_steps=config.decay_steps,
         )
+        self.lr_schedule = train_lib.make_schedule(
+            config.learning_rate, config.warmup_steps, config.decay_steps
+        )
+        self.numeric_monitor = None
+        if config.numeric_checks:
+            from dlrover_tpu.trainer.numeric_health import (
+                NumericHealthMonitor,
+            )
+
+            self.numeric_monitor = NumericHealthMonitor()
+        self.epoch = 0
         self.train = train_lib.build_sharded_train(
             self.model, self.optimizer, self.mesh,
             rules if rules is not None else lr.DEFAULT_RULES,
@@ -129,41 +180,108 @@ class ElasticTrainer:
         self.step += 1
         return metrics
 
+    def _dispatch(self, hook: str, *args):
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(self, *args)
+            except Exception as e:  # noqa: BLE001 - one callback must not
+                logger.warning("callback %s.%s failed: %s",
+                               type(cb).__name__, hook, e)
+
+    def current_lr(self) -> float:
+        """The LR the schedule prescribes at the current step."""
+        if callable(self.lr_schedule):
+            return float(self.lr_schedule(self.step))
+        return float(self.lr_schedule)
+
+    def evaluate(
+        self,
+        eval_loader: Iterable[Dict[str, Any]],
+        max_batches: int = 0,
+    ) -> Dict[str, float]:
+        """Forward-only evaluation: mean loss + perplexity over the loader
+        (ref ``atorch_trainer.py`` ``evaluate``/``prediction_loop``)."""
+        total_loss, total_tokens, batches = 0.0, 0.0, 0
+        for batch in eval_loader:
+            if max_batches and batches >= max_batches:
+                break
+            placed = train_lib.shard_batch(batch, self.train)
+            metrics = self.train.eval_step(self.state, placed)
+            tokens = float(metrics["tokens"])
+            total_loss += float(metrics["loss"]) * tokens
+            total_tokens += tokens
+            batches += 1
+        mean_loss = total_loss / total_tokens if total_tokens else float("nan")
+        out = {
+            "eval_loss": mean_loss,
+            "eval_ppl": float(np.exp(min(mean_loss, 30.0))),
+            "eval_tokens": total_tokens,
+            "eval_batches": batches,
+        }
+        logger.info(
+            "eval @ step %d: loss %.4f ppl %.2f (%d batches)",
+            self.step, mean_loss, out["eval_ppl"], batches,
+        )
+        self._dispatch("on_evaluate", self.step, out)
+        return out
+
     def fit(
         self,
         loader: Iterable[Dict[str, Any]],
         max_steps: int,
         on_step: Optional[Callable[[int, Dict], None]] = None,
+        eval_loader: Optional[Iterable[Dict[str, Any]]] = None,
+        epochs: int = 0,
     ) -> int:
         """Run until ``max_steps``; returns the final step.
 
         ``on_step(step, metrics)`` runs after every step (test hooks,
         custom logging); metrics values are still on device unless read.
+        ``eval_loader`` + ``config.eval_every`` turn on periodic
+        evaluation.  ``epochs > 0`` re-iterates ``loader`` that many times
+        (resume-aware: a restored trainer continues counting from its
+        restored step, and for a SIZED loader the epoch counter resumes at
+        ``step // len(loader)``; an unsized generator cannot imply an
+        epoch, so its counter restarts at 0).
         """
         cfg = self.config
         t_start = time.monotonic()
         start_step = self.step
-        for batch in loader:
-            if self.step >= max_steps:
-                break
-            metrics = self.train_step(batch)
-            if on_step is not None:
-                on_step(self.step, metrics)
-            if self.step % cfg.report_every == 0 or self.step == max_steps:
-                loss = float(metrics["loss"])
-                logger.info("step %d loss %.4f", self.step, loss)
-                if self.client is not None:
-                    self.client.report_step(
-                        self.step,
-                        tokens=cfg.global_batch_size * cfg.seq_len
-                        * cfg.report_every,
-                        loss=loss,
-                    )
-                from dlrover_tpu.agent.monitor import write_device_metrics
-
-                write_device_metrics()
-            if self.step % cfg.ckpt_every == 0 or self.step == max_steps:
-                self.save_checkpoint()
+        steps_per_epoch = None
+        if epochs and hasattr(loader, "__len__"):
+            steps_per_epoch = max(1, len(loader))
+            # Resume accounting: a restored step implies the epoch.
+            self.epoch = self.step // steps_per_epoch
+        self._dispatch("on_train_begin")
+        done = False
+        epoch_iterations = max(1, epochs) if epochs else 1
+        while not done:
+            for batch in loader:
+                if self.step >= max_steps:
+                    done = True
+                    break
+                metrics = self.train_step(batch)
+                if on_step is not None:
+                    on_step(self.step, metrics)
+                self._dispatch("on_step_end", self.step, metrics)
+                if self.step % cfg.report_every == 0 or (
+                    self.step == max_steps
+                ):
+                    self._report(metrics)
+                if cfg.eval_every and eval_loader is not None and (
+                    self.step % cfg.eval_every == 0
+                ):
+                    self.evaluate(eval_loader, cfg.eval_batches)
+                if self.step % cfg.ckpt_every == 0 or self.step == max_steps:
+                    self.save_checkpoint()
+            else:
+                # Loader exhausted: an epoch boundary.
+                self.epoch += 1
+                self._dispatch("on_epoch_end", self.epoch)
+                if epochs and self.epoch >= epoch_iterations:
+                    done = True
+                if not epochs:
+                    done = True
         if self._last_saved < self.step:
             # A restart can resume at (or past) max_steps with the newest
             # state only in a previous world's uncommitted files — persist
@@ -175,7 +293,37 @@ class ElasticTrainer:
             "done: %d steps (%.1f tokens/s)", self.step,
             tokens / elapsed if elapsed > 0 else 0.0,
         )
+        self._dispatch("on_train_end", self.step)
         return self.step
+
+    def _report(self, metrics: Dict[str, Any]):
+        cfg = self.config
+        loss = float(metrics["loss"])
+        logger.info(
+            "step %d loss %.4f lr %.3g", self.step, loss, self.current_lr()
+        )
+        anomalies = ()
+        if self.numeric_monitor is not None:
+            grad_norm = metrics.get("grad_norm")
+            found = self.numeric_monitor.check(
+                self.step, loss,
+                float(grad_norm) if grad_norm is not None else None,
+            )
+            if found:
+                for a in found:
+                    logger.error("numeric anomaly: %s", a.encode())
+                anomalies = tuple(a.encode() for a in found)
+        if self.client is not None:
+            self.client.report_step(
+                self.step,
+                tokens=cfg.global_batch_size * cfg.seq_len
+                * cfg.report_every,
+                loss=loss,
+                anomalies=anomalies,
+            )
+        from dlrover_tpu.agent.monitor import write_device_metrics
+
+        write_device_metrics()
 
     # -- checkpoint -----------------------------------------------------------
 
@@ -186,6 +334,7 @@ class ElasticTrainer:
 
         self._ckpt.save_checkpoint(self.step, self.state, StorageType.DISK)
         self._last_saved = self.step
+        self._dispatch("on_checkpoint", self.step)
 
     def close(self, wait: float = 120.0):
         if self._ckpt is not None:

@@ -34,6 +34,32 @@ def use_mesh(mesh: Mesh):
     return jax.set_mesh(mesh)
 
 
+def make_schedule(
+    learning_rate: float,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+):
+    """The LR schedule ``make_optimizer`` installs — exposed so the trainer
+    façade can log the live LR (``schedule(step)``) without re-deriving it."""
+    if warmup_steps and not decay_steps:
+        # Warmup-only: ramp to peak then hold (a cosine schedule here would
+        # collapse to end_value one step after warmup).
+        return optax.linear_schedule(
+            init_value=0.0,
+            end_value=learning_rate,
+            transition_steps=max(1, warmup_steps),
+        )
+    if warmup_steps or decay_steps:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=max(1, warmup_steps),
+            decay_steps=max(decay_steps, warmup_steps + 1),
+            end_value=learning_rate * 0.1,
+        )
+    return learning_rate
+
+
 def make_optimizer(
     name: str = "adamw",
     learning_rate: float = 3e-4,
@@ -45,24 +71,7 @@ def make_optimizer(
     decay_steps: int = 0,
     **kwargs,
 ) -> optax.GradientTransformation:
-    if warmup_steps and not decay_steps:
-        # Warmup-only: ramp to peak then hold (a cosine schedule here would
-        # collapse to end_value one step after warmup).
-        schedule = optax.linear_schedule(
-            init_value=0.0,
-            end_value=learning_rate,
-            transition_steps=max(1, warmup_steps),
-        )
-    elif warmup_steps or decay_steps:
-        schedule = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=learning_rate,
-            warmup_steps=max(1, warmup_steps),
-            decay_steps=max(decay_steps, warmup_steps + 1),
-            end_value=learning_rate * 0.1,
-        )
-    else:
-        schedule = learning_rate
+    schedule = make_schedule(learning_rate, warmup_steps, decay_steps)
     if name == "adamw":
         opt = optax.adamw(
             schedule, b1=b1, b2=b2, weight_decay=weight_decay, **kwargs
@@ -215,6 +224,11 @@ class ShardedTrain:
         with use_mesh(self.mesh):
             return self.step_fn(state, batch)
 
+    def eval_step(self, state: TrainState, batch: Dict[str, jax.Array]):
+        """Forward-only loss on one batch -> {"loss", "tokens"}."""
+        with use_mesh(self.mesh):
+            return self.eval_fn(state, batch)
+
 
 def _sanitize_boxes(tree):
     """Drop sharding boxes whose axis names no longer match the value rank.
@@ -345,6 +359,25 @@ def build_sharded_train(
                 return fn(*args, **kwargs)
         return wrapped
 
+    def _eval_step(state: TrainState, batch: Dict[str, jax.Array]):
+        """Forward-only CE (the fit-loop's eval half; no state mutation)."""
+        if ce_chunks:
+            hidden, aux = state.apply_fn(
+                {"params": state.params}, batch["inputs"], return_hidden=True
+            )
+            ce, total_weight = chunked_cross_entropy_loss(
+                hidden, output_head(state.params), batch["targets"],
+                batch["weights"], num_chunks=ce_chunks,
+            )
+        else:
+            logits, aux = state.apply_fn(
+                {"params": state.params}, batch["inputs"]
+            )
+            ce, total_weight = cross_entropy_loss(
+                logits, batch["targets"], batch["weights"]
+            )
+        return {"loss": ce, "aux_loss": aux, "tokens": total_weight}
+
     init_jit = jax.jit(
         _wrap_with_rules(_init), out_shardings=state_shardings
     )
@@ -354,6 +387,10 @@ def build_sharded_train(
         out_shardings=(state_shardings, None),
         donate_argnums=(0,) if donate_state else (),
     )
+    eval_jit = jax.jit(
+        _wrap_with_rules(_eval_step),
+        in_shardings=(state_shardings, batch_shardings),
+    )
 
     return ShardedTrain(
         mesh=mesh,
@@ -362,6 +399,7 @@ def build_sharded_train(
         batch_shardings=batch_shardings,
         init_fn=init_jit,
         step_fn=step_jit,
+        eval_fn=eval_jit,
     )
 
 

@@ -1,0 +1,217 @@
+"""ElasticTrainer façade parity: callbacks, eval loop, LR schedule, epoch
+accounting, splitter family, text shard reader.
+
+VERDICT r3 #8/#10 (ref ``atorch/atorch/trainer/atorch_trainer.py:136``
+callbacks/eval/schedules; ``dlrover/python/master/shard/
+dataset_splitter.py:144-357`` table/text splitters).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dlrover_tpu.master.messages import DatasetShardParams
+from dlrover_tpu.master.task_manager import (
+    DatasetManager,
+    StreamingDatasetSplitter,
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+    make_splitter,
+)
+from dlrover_tpu.data.text_shards import TextShardReader
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticTrainer,
+    TrainerCallback,
+    TrainerConfig,
+)
+
+BATCH, SEQ = 8, 32
+
+
+@pytest.fixture(autouse=True)
+def _isolated_shm(monkeypatch, tmp_path):
+    """The flash-ckpt shm arena outlives processes and is named by the job
+    tag: without a unique tag, a previous run's arena (holding a newer
+    step) would satisfy this test's restore."""
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"tf{os.getpid()}_{tmp_path.name}")
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+
+
+def _tiny_trainer(tmp_path=None, **cfg_kwargs):
+    model_config = gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=2, vocab_size=128,
+        max_seq_len=SEQ, param_dtype=jnp.float32,
+    )
+    cfg = TrainerConfig(
+        global_batch_size=BATCH, seq_len=SEQ, learning_rate=1e-2,
+        checkpoint_dir=str(tmp_path) if tmp_path else "",
+        ckpt_every=1000, report_every=2, **cfg_kwargs,
+    )
+    return ElasticTrainer(model_config, cfg, client=False or None)
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        toks = rng.integers(0, 128, size=(BATCH, SEQ + 1), dtype=np.int32)
+        yield {"inputs": toks[:, :-1].copy(), "targets": toks[:, 1:].copy()}
+
+
+class Recorder(TrainerCallback):
+    def __init__(self):
+        self.events = []
+
+    def on_train_begin(self, trainer):
+        self.events.append("begin")
+
+    def on_step_end(self, trainer, step, metrics):
+        self.events.append(("step", step))
+
+    def on_evaluate(self, trainer, step, eval_metrics):
+        self.events.append(("eval", step, eval_metrics["eval_loss"]))
+
+    def on_epoch_end(self, trainer, epoch):
+        self.events.append(("epoch", epoch))
+
+    def on_train_end(self, trainer, step):
+        self.events.append(("end", step))
+
+
+def test_fit_with_callbacks_eval_and_lr():
+    trainer = _tiny_trainer(
+        warmup_steps=4, decay_steps=20, eval_every=3, eval_batches=2,
+        numeric_checks=True,
+    )
+    recorder = Recorder()
+    trainer.callbacks.append(recorder)
+    lr_start = trainer.current_lr()
+    assert lr_start == 0.0  # warmup starts at zero
+    final = trainer.fit(
+        _batches(8), max_steps=8,
+        eval_loader=list(_batches(3, seed=1)),
+    )
+    assert final == 8
+    kinds = [e if isinstance(e, str) else e[0] for e in recorder.events]
+    assert kinds[0] == "begin" and kinds[-1] == "end"
+    assert kinds.count("step") == 8
+    evals = [e for e in recorder.events if e[0] == "eval"]
+    assert len(evals) == 2  # steps 3 and 6
+    assert all(np.isfinite(e[2]) for e in evals)
+    # warmup climbed the schedule
+    assert trainer.current_lr() > lr_start
+
+
+def test_fit_epochs_and_resume_accounting(tmp_path):
+    trainer = _tiny_trainer(tmp_path=tmp_path)
+    recorder = Recorder()
+    trainer.callbacks.append(recorder)
+    data = list(_batches(3))
+    trainer.fit(data, max_steps=6, epochs=2)
+    assert trainer.step == 6
+    assert trainer.epoch == 2
+    epochs = [e for e in recorder.events if e[0] == "epoch"]
+    assert [e[1] for e in epochs] == [1, 2]
+    trainer.close()
+
+    # A resumed trainer picks the epoch up from the restored step.
+    resumed = _tiny_trainer(tmp_path=tmp_path)
+    assert resumed.step == 6
+    resumed.fit(data, max_steps=9, epochs=3)
+    assert resumed.step == 9
+    assert resumed.epoch >= 3
+    resumed.close()
+
+
+def test_evaluate_standalone():
+    trainer = _tiny_trainer()
+    out = trainer.evaluate(list(_batches(4, seed=3)), max_batches=2)
+    assert out["eval_batches"] == 2
+    assert np.isfinite(out["eval_loss"]) and out["eval_ppl"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Splitter family + text shards
+# ---------------------------------------------------------------------------
+
+
+def test_make_splitter_maps_storage_types():
+    base = dict(dataset_name="d", dataset_size=100, shard_size=10)
+    assert isinstance(
+        make_splitter(DatasetShardParams(storage_type="table", **base)),
+        TableDatasetSplitter,
+    )
+    assert isinstance(
+        make_splitter(DatasetShardParams(storage_type="text", **base)),
+        TextDatasetSplitter,
+    )
+    assert isinstance(
+        make_splitter(DatasetShardParams(storage_type="stream", **base)),
+        StreamingDatasetSplitter,
+    )
+
+
+def test_text_splitter_shards_roundtrip_through_checkpoint():
+    params = DatasetShardParams(
+        dataset_name="corpus", dataset_size=25, shard_size=10,
+        storage_type="text", num_epochs=1,
+    )
+    manager = DatasetManager(make_splitter(params))
+    first = manager.get_task(node_id=0)
+    assert (first.start, first.end) == (0, 10)
+    # Checkpoint with one shard in flight + two pending; restore requeues all.
+    state = manager.checkpoint()
+    restored = DatasetManager(make_splitter(params))
+    restored.restore(state)
+    ranges = sorted(
+        (t.start, t.end) for t in restored.pending
+    )
+    assert ranges == [(0, 10), (10, 20), (20, 25)]  # short tail shard kept
+
+
+def test_text_shard_reader_reads_ranges(tmp_path):
+    path = tmp_path / "corpus.txt"
+    lines = [f"line-{i}" for i in range(25)]
+    path.write_text("\n".join(lines) + "\n")
+    reader = TextShardReader(str(path))
+    assert reader.num_lines == 25
+    assert reader.read_shard(0, 3) == ["line-0", "line-1", "line-2"]
+    assert reader.read_shard(20, 30) == [f"line-{i}" for i in range(20, 25)]
+    assert reader.read_shard(25, 30) == []
+    reader.close()
+    # index is cached and reused
+    reader2 = TextShardReader(str(path))
+    assert reader2.read_shard(10, 12) == ["line-10", "line-11"]
+    reader2.close()
+    # stale index (file grew) is rebuilt
+    with open(path, "a") as f:
+        f.write("line-25\n")
+    reader3 = TextShardReader(str(path))
+    assert reader3.num_lines == 26
+    assert reader3.read_shard(25, 26) == ["line-25"]
+    reader3.close()
+
+
+def test_text_reader_drives_table_shards_end_to_end(tmp_path):
+    """Master splits by line ranges; the worker reads exactly those lines."""
+    path = tmp_path / "data.txt"
+    path.write_text("".join(f"sample {i}\n" for i in range(40)))
+    reader = TextShardReader(str(path))
+    params = DatasetShardParams(
+        dataset_name="d", dataset_size=reader.num_lines, shard_size=16,
+        storage_type="text",
+    )
+    manager = DatasetManager(make_splitter(params))
+    seen = []
+    while True:
+        task = manager.get_task(node_id=0)
+        if task.task_id < 0:
+            break
+        seen.extend(reader.read_shard(task.start, task.end))
+        manager.report_task(task.task_id, success=True)
+    assert seen == [f"sample {i}" for i in range(40)]
+    assert manager.finished()
+    reader.close()

@@ -88,6 +88,12 @@ class Candidate:
     parallel: ParallelConfig
     remat: str
     global_batch_size: int = 0   # 0 = the caller's requested batch
+    # Widened knobs (PROFILE.md-proven; VERDICT r3 #9).  0/False sentinels
+    # mean "model default" so old call sites keep their behavior.
+    flash_block: Tuple[int, int] = (0, 0)   # (block_q, block_kv)
+    ce_chunks: int = 0                       # 0 = unchunked CE
+    microbatches: int = 0                    # 0 = pipe default
+    quantized_dcn: bool = False              # int8 DCN collectives
     est_step_time: float = math.inf
     est_hbm_gb: float = math.inf
     measured_step_time: Optional[float] = None
@@ -102,7 +108,16 @@ class Candidate:
         }
         live = ",".join(f"{k}={v}" for k, v in axes.items() if v not in (1,))
         batch = f" gbs={self.global_batch_size}" if self.global_batch_size else ""
-        return f"[{live or 'dp=1'} remat={self.remat}{batch}]"
+        extras = ""
+        if self.flash_block != (0, 0):
+            extras += f" fb={self.flash_block[0]}x{self.flash_block[1]}"
+        if self.ce_chunks:
+            extras += f" ce={self.ce_chunks}"
+        if self.microbatches:
+            extras += f" mb={self.microbatches}"
+        if self.quantized_dcn:
+            extras += " q8dcn"
+        return f"[{live or 'dp=1'} remat={self.remat}{batch}{extras}]"
 
 
 @dataclasses.dataclass
@@ -112,6 +127,8 @@ class TuneResult:
     remat: str
     candidates: List[Candidate]
     global_batch_size: int = 0  # only set by search_batch=True
+    ce_chunks: int = 0          # winner's CE chunking (search_kernels)
+    quantized_dcn: bool = False  # winner's DCN transport choice
 
     @property
     def best(self) -> Candidate:
@@ -122,22 +139,74 @@ def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+def _flash_factor(block_kv: int, seq_len: int) -> float:
+    """Relative attention-kernel cost by kv block (PROFILE.md r3 table)."""
+    if block_kv >= min(seq_len, 1024):
+        return 1.0   # one kv block: the fused single-pass backward engages
+    return 1.06 if block_kv >= 512 else 1.13
+
+
+def _knob_space(
+    config: TransformerConfig,
+    seq_len: int,
+    pipe: int,
+    *,
+    search_kernels: bool,
+    multihost: bool,
+) -> List[Dict]:
+    """The per-mesh knob combinations (flash blocks x CE chunking x
+    microbatches x DCN quantization) — the dimensions PROFILE.md measured
+    as mattering, which the reference searches with its strategy library
+    + BO (ref ``auto/engine/sg_algo/bayes_opt_sg.py``)."""
+    if search_kernels and config.attention_impl == "flash":
+        pads = 1 << max(seq_len - 1, 1).bit_length() if seq_len & (
+            seq_len - 1
+        ) else seq_len
+        sizes = [b for b in (256, 512, 1024) if b <= pads]
+        blocks = [(0, 0)] + [
+            (bq, bkv) for bq in sizes for bkv in sizes
+            if not (bq == bkv == sizes[-1])  # largest pair ~= default
+        ]
+    else:
+        blocks = [(0, 0)]
+    ce_options = [0, 16] if search_kernels else [0]
+    if pipe > 1:
+        micro = [pipe, 2 * pipe, 4 * pipe]
+    else:
+        micro = [0]
+    dcn = [False, True] if multihost else [False]
+    return [
+        {"flash_block": fb, "ce_chunks": ce, "microbatches": mb,
+         "quantized_dcn": q}
+        for fb in blocks for ce in ce_options for mb in micro for q in dcn
+    ]
+
+
 def enumerate_candidates(
     config: TransformerConfig,
     n_devices: int,
     remat_policies: Sequence[str] = ("attn_out", "branch_out", "full"),
     max_tensor: int = 8,
     include_pipeline: bool = True,
+    search_kernels: bool = False,
+    seq_len: int = 0,
+    multihost: bool = False,
 ) -> List[Candidate]:
-    """All legal (mesh factorization x remat) combinations.
+    """All legal (mesh factorization x remat [x kernel knobs]) combinations.
 
     Legality (divisibility) mirrors the reference's strategy feasibility
     checks (ref ``atorch/auto/opt_lib``'s per-optimization
     ``applicable``): tensor and seq must divide the head count (Ulysses
     shards heads over seq x tensor inside attention), expert must divide
     the expert count, pipe must divide the layer count.
+
+    ``search_kernels=True`` widens the space with the measured-impact knobs
+    (flash block sizes, CE chunking, microbatch counts, quantized DCN
+    collectives); the sampled-search fallback in :func:`auto_tune` keeps
+    the widened space tractable.
     """
     heads = config.num_heads
+    seq_len = seq_len or config.max_seq_len
     candidates: List[Candidate] = []
     seen = set()
     for tensor in _divisors(n_devices):
@@ -170,8 +239,16 @@ def enumerate_candidates(
                             data=data, fsdp=fsdp, pipe=pipe,
                             expert=expert, seq=seq, tensor=tensor,
                         )
+                        knobs = _knob_space(
+                            config, seq_len, pipe,
+                            search_kernels=search_kernels,
+                            multihost=multihost,
+                        )
                         for remat in remat_policies:
-                            candidates.append(Candidate(parallel, remat))
+                            for kn in knobs:
+                                candidates.append(
+                                    Candidate(parallel, remat, **kn)
+                                )
     return candidates
 
 
@@ -207,9 +284,17 @@ def _estimate(
         tokens_local * config.num_layers * config.d_model * 2 * act_mult
         / max(p.tensor, 1) / max(p.pipe, 1)
     )
-    # transient working set (attention + MLP blocks, CE chunks)
+    # transient working set (attention + MLP blocks)
     work_b = tokens_local * config.resolved_d_ff * 2 * 4 / max(p.tensor, 1)
-    total_b = (param_b + grad_b + opt_b + act_b + work_b) * 1.15  # frag pad
+    # Logits working set: unchunked CE materializes [tokens, vocab] fp32
+    # (measured 3.3 GiB at bench shapes); chunking divides it.
+    logits_b = (
+        tokens_local * config.vocab_size * 4
+        / max(cand.ce_chunks, 1) / max(p.tensor, 1)
+    )
+    total_b = (
+        param_b + grad_b + opt_b + act_b + work_b + logits_b
+    ) * 1.15  # frag pad
     cand.est_hbm_gb = total_b / 2**30
     if total_b > hbm_bytes * 0.92:
         cand.rejected = (
@@ -224,6 +309,27 @@ def _estimate(
     ) / n_devices
     mxu_eff = 0.55  # measured sustained efficiency at bench shapes
     t_compute = flops_dev / (peak_flops * mxu_eff)
+    # Flash block sizes: measured relative attention-kernel cost on v5e at
+    # seq 1024 (PROFILE.md round 3 table; one-kv-block is fastest because
+    # the fused single-pass backward engages).  Attention is ~20% of the
+    # step at bench shapes.  The (0,0) sentinel means "the model config's
+    # own blocks" and is priced from those — so when the config default is
+    # sub-optimal an explicit block choice can genuinely win the ranking.
+    if config.attention_impl == "flash":
+        bq, bkv = cand.flash_block
+        if (bq, bkv) == (0, 0):
+            bq, bkv = config.flash_block_q, config.flash_block_kv
+        t_compute *= 0.8 + 0.2 * _flash_factor(bkv, seq_len)
+    # Chunked CE re-runs the logits matmul per chunk boundary: measured
+    # +-0.5% at bench shapes — time-neutral, memory is its real effect.
+    if cand.ce_chunks:
+        t_compute *= 1.005
+    # Quantized DCN collectives pay for their bandwidth saving with
+    # quantize/dequantize sweeps over the gradient tree (~3 extra HBM
+    # passes of the sharded params) — the knob must not be a free win in
+    # the estimate when it cannot be exercised by _measure.
+    if cand.quantized_dcn:
+        t_compute += 3 * (n * 2 / shard) / hbm_bw
     # HBM: weights stream fwd+bwd+update, activations twice
     t_hbm = (param_b * 6 + opt_b + act_b * 2) / hbm_bw
     # ICI: fsdp all-gather + reduce-scatter of params, dp grad all-reduce,
@@ -237,12 +343,28 @@ def _estimate(
         coll_b += 4 * tokens_local * config.d_model * 2
     if p.tensor > 1:
         coll_b += 4 * tokens_local * config.d_model * 2 * config.num_layers
+    # DCN-crossing gradient traffic: int8-quantized collectives
+    # (parallel/quantized_collectives.py) cut the bytes ~3.5x (int8
+    # payload + fp scales vs bf16) at a small dequant-compute cost.  The
+    # knob is only enumerated for multihost jobs, where the data-axis
+    # gradient all-reduce is the traffic that rides DCN.
+    if cand.quantized_dcn and p.data > 1:
+        dcn_b = 2 * n * 2 / shard * (p.data - 1) / p.data
+        coll_b -= dcn_b * (1 - 1 / 3.5)
     t_ici = coll_b / ici_bw
-    # pipeline bubble: (S-1)/(T+S-1) idle fraction
+    # pipeline bubble: (S-1)/(T+S-1) idle fraction; more microbatches
+    # shrink the bubble but below a per-microbatch floor the smaller
+    # per-step matmuls lose MXU efficiency (searchable knob).
     bubble = 1.0
     if p.pipe > 1:
-        micro = max(config.num_microbatches or p.pipe, p.pipe)
+        micro = max(
+            cand.microbatches or config.num_microbatches or p.pipe, p.pipe
+        )
         bubble = 1 + (p.pipe - 1) / micro
+        rows_per_micro = tokens / seq_len / max(p.data * p.fsdp, 1) / micro
+        if rows_per_micro < 1:
+            cand.rejected = f"microbatches {micro} > local batch rows"
+            return
     cand.est_step_time = (max(t_compute, t_hbm) + t_ici) * bubble
 
 
@@ -260,14 +382,18 @@ def _measure(
     from dlrover_tpu.runtime.mesh import build_mesh
     from dlrover_tpu.trainer import train_lib
 
-    model_cfg = dataclasses.replace(
-        config,
+    overrides: Dict = dict(
         remat=cand.remat,
         pipeline_stages=cand.parallel.pipe,
         num_microbatches=(
-            cand.parallel.pipe if cand.parallel.pipe > 1 else 0
+            (cand.microbatches or cand.parallel.pipe)
+            if cand.parallel.pipe > 1 else 0
         ),
     )
+    if cand.flash_block != (0, 0):
+        overrides["flash_block_q"] = cand.flash_block[0]
+        overrides["flash_block_kv"] = cand.flash_block[1]
+    model_cfg = dataclasses.replace(config, **overrides)
     from dlrover_tpu.models.transformer import TransformerLM
 
     try:
@@ -277,6 +403,7 @@ def _measure(
         train = train_lib.build_sharded_train(
             model, opt, mesh, lr.DEFAULT_RULES,
             global_batch_size=global_batch_size, seq_len=seq_len,
+            ce_chunks=cand.ce_chunks,
         )
         state = train.init(jax.random.PRNGKey(0))
         rng = np.random.default_rng(0)
@@ -300,6 +427,45 @@ def _measure(
         return None
 
 
+def _cand_key(c: Candidate):
+    p = c.parallel
+    return (
+        p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor, c.remat,
+        c.global_batch_size, c.flash_block, c.ce_chunks, c.microbatches,
+        c.quantized_dcn,
+    )
+
+
+def _knob_neighbors(
+    leaders: List[Candidate],
+    config: TransformerConfig,
+    seq_len: int,
+    *,
+    search_kernels: bool,
+    multihost: bool,
+) -> List[Candidate]:
+    """All single-knob variations of the leaders (mesh axes held fixed)."""
+    out: List[Candidate] = []
+    for cand in leaders:
+        space = _knob_space(
+            config, seq_len, cand.parallel.pipe,
+            search_kernels=search_kernels, multihost=multihost,
+        )
+        knob_values: Dict[str, set] = {}
+        for kn in space:
+            for key, value in kn.items():
+                knob_values.setdefault(key, set()).add(value)
+        for key, values in knob_values.items():
+            for value in values:
+                if getattr(cand, key) != value:
+                    out.append(dataclasses.replace(
+                        cand, **{key: value},
+                        est_step_time=math.inf, est_hbm_gb=math.inf,
+                        rejected="",
+                    ))
+    return out
+
+
 _REMAT_CODES = {"none": 0, "full": 1, "dots": 2, "attn_out": 3,
                 "branch_out": 4}
 
@@ -311,7 +477,9 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
     p = best.parallel
     key = np.asarray(
         [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
-         _REMAT_CODES.get(best.remat, -1), best.global_batch_size],
+         _REMAT_CODES.get(best.remat, -1), best.global_batch_size,
+         best.flash_block[0], best.flash_block[1], best.ce_chunks,
+         best.microbatches, int(best.quantized_dcn)],
         np.int64,
     )
     agreed = multihost_utils.broadcast_one_to_all(key)
@@ -323,14 +491,20 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         expert=int(agreed[3]), seq=int(agreed[4]), tensor=int(agreed[5]),
     )
     remat = codes.get(int(agreed[6]), best.remat)
-    batch = int(agreed[7])
+    knobs = dict(
+        global_batch_size=int(agreed[7]),
+        flash_block=(int(agreed[8]), int(agreed[9])),
+        ce_chunks=int(agreed[10]),
+        microbatches=int(agreed[11]),
+        quantized_dcn=bool(agreed[12]),
+    )
     for cand in ranked:
         if (
             cand.parallel == parallel and cand.remat == remat
-            and cand.global_batch_size == batch
+            and all(getattr(cand, k) == v for k, v in knobs.items())
         ):
             return cand
-    return Candidate(parallel, remat, global_batch_size=batch)
+    return Candidate(parallel, remat, **knobs)
 
 
 def auto_tune(
@@ -345,6 +519,8 @@ def auto_tune(
     devices=None,
     include_pipeline: bool = True,
     search_batch: bool = False,
+    search_kernels: bool = False,
+    max_enumerate: int = 32768,
 ) -> TuneResult:
     """Find the best (ParallelConfig, remat) for ``config`` on this mesh.
 
@@ -358,6 +534,15 @@ def auto_tune(
     ranks by estimated *throughput* instead of step time; the winner's
     batch lands on ``TuneResult.global_batch_size``.  Opt-in because a
     changed batch changes training semantics.
+
+    ``search_kernels=True`` widens the space with the measured-impact
+    kernel knobs (flash block sizes, CE chunking, pipeline microbatch
+    counts, quantized DCN collectives — PROFILE.md's proven levers).  A
+    space larger than ``max_enumerate`` falls back to seeded sampling plus
+    single-knob neighborhood refinement of the estimator's leaders — the
+    explore/exploit role the reference gives Bayesian optimization
+    (``auto/engine/sg_algo/bayes_opt_sg.py``), deterministic here so every
+    host enumerates the same space.
     """
     devices = list(devices if devices is not None else jax.devices())
     n_devices = n_devices or len(devices)
@@ -365,8 +550,19 @@ def auto_tune(
     seq_len = seq_len or config.max_seq_len
 
     base = enumerate_candidates(
-        config, n_devices, include_pipeline=include_pipeline
+        config, n_devices, include_pipeline=include_pipeline,
+        search_kernels=search_kernels, seq_len=seq_len,
+        multihost=jax.process_count() > 1,
     )
+    sampled = len(base) > max_enumerate
+    if sampled:
+        rng = np.random.default_rng(0)  # identical sample on every host
+        idx = rng.choice(len(base), size=max_enumerate, replace=False)
+        logger.info(
+            "auto_tune: sampling %d of %d candidates", max_enumerate,
+            len(base),
+        )
+        base = [base[i] for i in sorted(idx)]
     if search_batch:
         candidates = []
         for mult in (1, 2, 4):
@@ -395,6 +591,31 @@ def auto_tune(
     feasible = sorted(
         (c for c in candidates if not c.rejected), key=est_rank
     )
+    if sampled and feasible:
+        # Refinement (the BO acquire step, deterministic): estimate every
+        # single-knob neighbor of the estimator's leaders — a uniform
+        # sample rarely contains the exact best knob combination.
+        neighbors = _knob_neighbors(
+            feasible[:8], config, seq_len,
+            search_kernels=search_kernels,
+            multihost=jax.process_count() > 1,
+        )
+        known = {_cand_key(c) for c in candidates}
+        fresh = []
+        for cand in neighbors:
+            key = _cand_key(cand)
+            if key not in known:
+                known.add(key)
+                fresh.append(cand)
+        for cand in fresh:
+            _estimate(
+                cand, config,
+                cand.global_batch_size or global_batch_size,
+                seq_len, optimizer, n_devices,
+            )
+        feasible = sorted(
+            feasible + [c for c in fresh if not c.rejected], key=est_rank
+        )
     if not feasible:
         raise ValueError(
             f"no feasible strategy for {n_devices} devices (all "
@@ -407,6 +628,22 @@ def auto_tune(
         [c.describe() for c in feasible[:5]],
     )
     if measure:
+        def _measure_key(c: Candidate):
+            # quantized_dcn is a transport knob _measure cannot exercise
+            # (the collective wiring lives in the Local-SGD layer):
+            # est-twins differing only in it compile identical programs,
+            # so measuring both wastes a finalist slot.
+            key = list(_cand_key(c))
+            key[-1] = False
+            return tuple(key)
+
+        measurable = []
+        seen_keys = set()
+        for cand in feasible:
+            key = _measure_key(cand)
+            if key not in seen_keys:
+                seen_keys.add(key)
+                measurable.append(cand)
         if search_batch:
             # Diversify finalists across batch sizes: the analytic model
             # favors the largest batch monotonically, so a top-k slice
@@ -414,19 +651,19 @@ def auto_tune(
             # error (e.g. a real-world OOM) would invalidate every
             # finalist at once with the safe batches never tried.
             finalists, seen_batches = [], set()
-            for cand in feasible:
+            for cand in measurable:
                 if cand.global_batch_size not in seen_batches:
                     finalists.append(cand)
                     seen_batches.add(cand.global_batch_size)
                 if len(finalists) >= max_measure:
                     break
-            for cand in feasible:
+            for cand in measurable:
                 if len(finalists) >= max_measure:
                     break
                 if cand not in finalists:
                     finalists.append(cand)
         else:
-            finalists = feasible[:max_measure]
+            finalists = measurable[:max_measure]
         for cand in finalists:
             batch = cand.global_batch_size or global_batch_size
             cand.measured_step_time = _measure(
@@ -464,12 +701,18 @@ def auto_tune(
         best.describe(), best.est_step_time,
         f"{best.measured_step_time:.3f}s" if best.measured_step_time else "-",
     )
-    model_cfg = dataclasses.replace(
-        config,
+    cfg_overrides: Dict = dict(
         remat=best.remat,
         pipeline_stages=best.parallel.pipe,
-        num_microbatches=best.parallel.pipe if best.parallel.pipe > 1 else 0,
+        num_microbatches=(
+            (best.microbatches or best.parallel.pipe)
+            if best.parallel.pipe > 1 else 0
+        ),
     )
+    if best.flash_block != (0, 0):
+        cfg_overrides["flash_block_q"] = best.flash_block[0]
+        cfg_overrides["flash_block_kv"] = best.flash_block[1]
+    model_cfg = dataclasses.replace(config, **cfg_overrides)
     return TuneResult(
         parallel=best.parallel,
         model_config=model_cfg,
@@ -478,4 +721,6 @@ def auto_tune(
         # 0 (the sentinel) whenever batch search was off: every candidate
         # then carries it.
         global_batch_size=best.global_batch_size,
+        ce_chunks=best.ce_chunks,
+        quantized_dcn=best.quantized_dcn,
     )

@@ -1,0 +1,240 @@
+"""Cross-host coworker data service: prepared batches over the gRPC fabric.
+
+Capability ref: ``atorch/atorch/service/coworker_data_service.py`` +
+``atorch/protos/coworker.proto`` (GetBatchData): coworker machines run the
+CPU-heavy preprocessing and ship collated batches to the training hosts,
+so trainer host CPUs drive the device instead of tokenizing.
+
+TPU redesign: the serving host runs a :class:`CoworkerDataLoader` (its
+worker processes fill the shared-memory ring locally) and a
+``CoworkerDataServer`` that drains the ring into a bounded outbox served
+over the same 2-RPC pickled-dataclass fabric as the master (grpc generic
+handler + restricted unpickler, ``master/messages.py``).  Training hosts
+iterate a :class:`RemoteBatchIterator`, which prefetches over DCN on a
+background thread.  Delivery is pull-based work-sharing: each batch goes
+to exactly one consumer, whichever asks first — the same semantics as the
+reference's shared batch pool.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+from concurrent import futures
+from typing import Dict, Iterable, Iterator, Optional
+
+import grpc
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master import messages as msg
+
+SERVICE = "dlrover_tpu.CoworkerData"
+FETCH = f"/{SERVICE}/fetch"
+
+
+def encode_batch(seq: int, batch: Dict[str, np.ndarray]) -> msg.BatchPayload:
+    meta: Dict = {}
+    parts = []
+    offset = 0
+    for key, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        meta[key] = (tuple(arr.shape), arr.dtype.str, offset)
+        parts.append(arr.tobytes())
+        offset += arr.nbytes
+    return msg.BatchPayload(seq=seq, meta=meta, data=b"".join(parts))
+
+
+def decode_batch(payload: msg.BatchPayload) -> Dict[str, np.ndarray]:
+    out = {}
+    for key, (shape, dtype, offset) in payload.meta.items():
+        size = int(np.prod(shape)) if shape else 1
+        out[key] = np.frombuffer(
+            payload.data, np.dtype(dtype), count=size, offset=offset
+        ).reshape(shape).copy()
+    return out
+
+
+class CoworkerDataServer:
+    """Serves batches from a local iterator to remote training hosts.
+
+    ``source`` is any iterator of ``dict[str, np.ndarray]`` — typically a
+    started :class:`CoworkerDataLoader` (whose shm ring is the local
+    buffer between ITS preprocessing workers and this server).  The
+    outbox is bounded: when no trainer is fetching, the producer thread
+    blocks and backpressure reaches the preprocessing workers through the
+    loader's own ring.
+    """
+
+    def __init__(self, source: Iterable[Dict[str, np.ndarray]],
+                 port: int = 0, outbox: int = 8):
+        self._source = source
+        self._outbox: "queue.Queue[msg.BatchPayload]" = queue.Queue(
+            maxsize=outbox
+        )
+        self._stop = threading.Event()
+        self._seq = 0
+        self._producer = threading.Thread(
+            target=self._produce, name="coworker-producer", daemon=True
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="coworker-rpc"
+            )
+        )
+        self._server.add_generic_rpc_handlers((_Handler(self),))
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+        self._server.start()
+        self._producer.start()
+        logger.info("coworker data server on port %d", self.port)
+
+    def _produce(self):
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                payload = encode_batch(self._seq, batch)
+                self._seq += 1
+                while not self._stop.is_set():
+                    try:
+                        self._outbox.put(payload, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception as e:  # noqa: BLE001 - ship the failure to consumers
+            logger.error("coworker producer failed: %s", e)
+            self._put_sentinel(msg.BatchPayload(end=True, error=repr(e)))
+            return
+        # Exhausted: every waiting/future consumer must learn the stream
+        # ended; the sentinel is re-enqueued on delivery (see fetch).
+        self._put_sentinel(msg.BatchPayload(end=True))
+
+    def _put_sentinel(self, payload: msg.BatchPayload):
+        # Stop-aware: a full outbox with no consumers must not wedge the
+        # producer thread forever holding an undeliverable sentinel.
+        while not self._stop.is_set():
+            try:
+                self._outbox.put(payload, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def fetch(self, env: msg.Envelope) -> msg.BatchPayload:
+        req: msg.BatchFetch = env.payload
+        try:
+            payload = self._outbox.get(
+                timeout=min(max(req.timeout_s, 0.1), 60.0)
+            )
+        except queue.Empty:
+            return msg.BatchPayload(retry=True)
+        if payload.end:
+            # Terminal: keep the sentinel available for every consumer.
+            self._outbox.put(payload)
+        return payload
+
+    def close(self):
+        self._stop.set()
+        self._server.stop(grace=0.5).wait()
+
+
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, server: CoworkerDataServer):
+        self._server = server
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != FETCH:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            lambda request, context: self._server.fetch(request),
+            request_deserializer=msg.safe_loads,
+            response_serializer=pickle.dumps,
+        )
+
+
+class RemoteBatchIterator:
+    """Training-host side: iterate batches served by a CoworkerDataServer.
+
+    A prefetch thread keeps ``prefetch`` decoded batches ready so the DCN
+    round-trip hides behind the training step.  Raises on a producer error
+    shipped in-band; ends cleanly on the server's end-of-stream.
+    """
+
+    def __init__(self, address: str, consumer: str = "",
+                 prefetch: int = 2, fetch_timeout_s: float = 5.0,
+                 total_timeout_s: float = 120.0):
+        self.address = address
+        self.consumer = consumer
+        self.fetch_timeout_s = fetch_timeout_s
+        self.total_timeout_s = total_timeout_s
+        self._channel = grpc.insecure_channel(address)
+        self._fetch = self._channel.unary_unary(
+            FETCH,
+            request_serializer=pickle.dumps,
+            response_deserializer=msg.safe_loads,
+        )
+        self._buffer: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._prefetch_loop, name="remote-batch-prefetch",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _request(self) -> msg.BatchPayload:
+        env = msg.Envelope(payload=msg.BatchFetch(
+            consumer=self.consumer, timeout_s=self.fetch_timeout_s,
+        ))
+        return self._fetch(env, timeout=self.fetch_timeout_s + 10.0)
+
+    def _prefetch_loop(self):
+        import time as _time
+
+        try:
+            idle_since = _time.monotonic()
+            while not self._stop.is_set():
+                try:
+                    payload = self._request()
+                except grpc.RpcError as e:
+                    if _time.monotonic() - idle_since > self.total_timeout_s:
+                        self._buffer.put(ConnectionError(
+                            f"coworker service unreachable at "
+                            f"{self.address}: "
+                            f"{e.code() if hasattr(e, 'code') else e}"
+                        ))
+                        return
+                    self._stop.wait(1.0)
+                    continue
+                # ANY successful RPC — including a "nothing ready yet"
+                # retry — proves the server alive: a slow-to-produce but
+                # healthy coworker must not count toward the timeout.
+                idle_since = _time.monotonic()
+                if payload.retry:
+                    continue
+                if payload.error:
+                    self._buffer.put(RuntimeError(
+                        f"coworker producer failed: {payload.error}"
+                    ))
+                    return
+                if payload.end:
+                    self._buffer.put(None)
+                    return
+                self._buffer.put(decode_batch(payload))
+        except Exception as e:  # noqa: BLE001 - a dead prefetch thread must
+            # surface, not leave __iter__ blocked on the buffer forever.
+            self._buffer.put(RuntimeError(
+                f"coworker prefetch failed: {e!r}"
+            ))
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            item = self._buffer.get()
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    def close(self):
+        self._stop.set()
+        self._channel.close()

@@ -73,7 +73,17 @@ class TextShardReader:
             return []
         self._file.seek(int(self._offsets[start]))
         blob = self._file.read(int(self._offsets[end] - self._offsets[start]))
-        return blob.decode("utf-8", errors="replace").splitlines()
+        # Split on the SAME delimiter the index counted (\n bytes):
+        # str.splitlines() also breaks on \v \f \x85   etc., which
+        # would return more "lines" than the master's line accounting.
+        lines = blob.split(b"\n")
+        if lines and lines[-1] == b"":
+            lines.pop()  # shard ends on a newline: no phantom last line
+        return [
+            ln[:-1].decode("utf-8", errors="replace")
+            if ln.endswith(b"\r") else ln.decode("utf-8", errors="replace")
+            for ln in lines
+        ]
 
     def close(self):
         self._file.close()

@@ -240,6 +240,15 @@ class CloudNodeLauncher(NodeLauncher):
         name = self.instance_name(node_id)
         last_err: Optional[CloudError] = None
         for attempt in range(self.CREATE_RETRIES):
+            with self._wanted_mu:
+                if node_id not in self._wanted:
+                    # Retired during a backoff window: creating now would
+                    # leak an untracked, billing VM.
+                    logger.info(
+                        "cloud launcher: abandoning create of retired "
+                        "node %d", node_id,
+                    )
+                    return
             existing = self.client.get_node(name)
             if existing is not None and existing["state"] in (
                 TpuVmState.CREATING, TpuVmState.READY

@@ -261,6 +261,30 @@ class ParalConfig:
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+@dataclasses.dataclass
+class BatchFetch:
+    """Cross-host coworker data service: one batch, please (ref
+    ``protos/coworker.proto`` GetBatchData)."""
+
+    consumer: str = ""
+    timeout_s: float = 10.0
+
+
+@dataclasses.dataclass
+class BatchPayload:
+    """One collated batch on the wire: raw bytes + per-array metadata
+    (shape, dtype str, byte offset) — no numpy objects in the pickle."""
+
+    seq: int = -1
+    meta: Dict[str, Tuple[Tuple[int, ...], str, int]] = dataclasses.field(
+        default_factory=dict
+    )
+    data: bytes = b""
+    end: bool = False       # producer exhausted: no more batches ever
+    retry: bool = False     # nothing ready inside timeout_s: ask again
+    error: str = ""
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     """Deserializer for the control-plane wire format.
 

@@ -97,3 +97,72 @@ def test_auto_tune_batch_search_opt_in():
         tiny_cfg(), global_batch_size=16, n_devices=n, measure=False,
     )
     assert plain.global_batch_size == 0
+
+
+def test_search_kernels_widens_space_and_estimates():
+    """VERDICT r3 #9: flash blocks / CE chunking / microbatches /
+    quantized-DCN knobs enter the search (estimate-ranked, no measure)."""
+    from dlrover_tpu.auto import tune
+
+    cfg = gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=4, vocab_size=512,
+        max_seq_len=512, attention_impl="flash",
+    )
+    narrow = tune.enumerate_candidates(cfg, 8, seq_len=512)
+    wide = tune.enumerate_candidates(
+        cfg, 8, search_kernels=True, seq_len=512, multihost=True,
+    )
+    assert len(wide) > 4 * len(narrow)
+    # every knob dimension is represented
+    assert any(c.flash_block != (0, 0) for c in wide)
+    assert any(c.ce_chunks == 16 for c in wide)
+    assert any(c.quantized_dcn for c in wide)
+    pipes = [c for c in wide if c.parallel.pipe > 1]
+    if pipes:
+        assert any(c.microbatches > c.parallel.pipe for c in pipes)
+
+    result = tune.auto_tune(
+        cfg, global_batch_size=16, seq_len=512, n_devices=8,
+        measure=False, search_kernels=True,
+    )
+    assert result.best.est_step_time != float("inf")
+    # the winner's knobs surface on the result
+    assert result.ce_chunks == result.best.ce_chunks
+    if result.best.flash_block != (0, 0):
+        assert result.model_config.flash_block_q == result.best.flash_block[0]
+
+
+def test_sampled_search_with_refinement_is_deterministic():
+    from dlrover_tpu.auto import tune
+
+    cfg = gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=4, vocab_size=512,
+        max_seq_len=512, attention_impl="flash",
+    )
+    kwargs = dict(
+        global_batch_size=16, seq_len=512, n_devices=8, measure=False,
+        search_kernels=True, max_enumerate=64,
+    )
+    a = tune.auto_tune(cfg, **kwargs)
+    b = tune.auto_tune(cfg, **kwargs)
+    assert tune._cand_key(a.best) == tune._cand_key(b.best)
+    assert len([c for c in a.candidates if not c.rejected]) > 0
+
+
+def test_unchunked_ce_memory_includes_logits():
+    """CE chunking's real effect is the logits working set: the estimator
+    must see it (it is what OOMs the 1.5B bench without chunking)."""
+    from dlrover_tpu.auto import tune
+    from dlrover_tpu.runtime.mesh import ParallelConfig
+
+    cfg = gpt2_config(
+        "124m", num_layers=2, d_model=64, num_heads=4, vocab_size=50304,
+        max_seq_len=512,
+    )
+    plain = tune.Candidate(ParallelConfig(data=8), "attn_out")
+    chunked = tune.Candidate(
+        ParallelConfig(data=8), "attn_out", ce_chunks=16
+    )
+    for cand in (plain, chunked):
+        tune._estimate(cand, cfg, 64, 512, "adamw", 8)
+    assert plain.est_hbm_gb > chunked.est_hbm_gb

@@ -183,6 +183,24 @@ class ElasticAgent:
         os.makedirs(socket_dir(), exist_ok=True)
         return os.path.join(socket_dir(), f"metrics_n{self.node_id}.json")
 
+    def _stack_file(self) -> str:
+        """Where the trainer's SIGUSR1 faulthandler dumps its stacks."""
+        from dlrover_tpu.common.multi_process import socket_dir
+
+        os.makedirs(socket_dir(), exist_ok=True)
+        return os.path.join(socket_dir(), f"stacks_n{self.node_id}.txt")
+
+    def dump_trainer_stacks(self, timeout_s: float = 3.0) -> str:
+        """Collect live Python stacks from the trainer (hang diagnosis;
+        ref ``datacollector/cuda_log_collector.py``)."""
+        from dlrover_tpu.agent.stack_collector import collect_stacks
+
+        if self._proc is None or self._proc.poll() is not None:
+            return ""
+        return collect_stacks(
+            self._proc.pid, self._stack_file(), timeout_s=timeout_s
+        )
+
     def _paral_config_file(self) -> str:
         """Master->trainer runtime-tunable-config handoff file (ref
         ``elastic_agent/config/paral_config_tuner.py:30-78``)."""
@@ -252,6 +270,9 @@ class ElasticAgent:
                 ENV_RESTART_COUNT: str(self._restart_count),
                 ConfigKey.METRICS_FILE: self._metrics_file(),
                 ConfigKey.PARAL_CONFIG_PATH: self._paral_config_file(),
+                # Stack-dump seam (agent/stack_collector.py): the trainer
+                # bootstrap registers a SIGUSR1 faulthandler writing here.
+                "DLROVER_TPU_STACK_FILE": self._stack_file(),
                 # Piped stdout would flip the trainer to 8KB block
                 # buffering, holding back exactly the final prints the
                 # failure-report log tail exists to capture.
@@ -370,6 +391,15 @@ class ElasticAgent:
 
     def _restart_workers(self):
         """ref ``_restart_workers:687``: in-place process restart, no new pod."""
+        # A LIVE trainer being torn down (membership change, hang
+        # remediation) gets its stacks collected first — where it was
+        # stuck is exactly what the post-incident diagnosis needs.
+        stacks = self.dump_trainer_stacks(timeout_s=2.0)
+        if stacks:
+            logger.info(
+                "trainer stacks at restart:\n%s",
+                "\n".join(stacks.splitlines()[:60]),
+            )
         self._restart_count += 1
         self._stop_workers()
         self._start_workers()

@@ -276,7 +276,7 @@ def _estimate(
     param_b = n * 2 / shard                       # bf16 params
     grad_b = n * 2 / shard
     opt_mult = {"adamw": 8.0, "adafactor": 0.2, "q8_adam": 2.2,
-                "sgd": 4.0, "lion": 4.0}.get(optimizer, 8.0)
+                "q4_adam": 1.25, "sgd": 4.0, "lion": 4.0}.get(optimizer, 8.0)
     opt_b = n * opt_mult / shard
     act_mult = _ACT_PER_TOKEN_LAYER.get(cand.remat, 4.0)
     tokens_local = tokens / max(p.data * p.fsdp, 1) / max(p.seq, 1)

@@ -50,6 +50,12 @@ class DenseGeneral(nn.Module):
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = default_kernel_init
+    # Store the kernel with (features..., in...) dims instead of
+    # (in..., features...): same math via swapped contraction dims, but a
+    # different operand orientation for XLA's emitter choice (measured on
+    # the wo matmul, PROFILE.md round 4).  kernel_axes follow the STORED
+    # order.  Checkpoint-format change where enabled.
+    transpose_kernel: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -57,7 +63,11 @@ class DenseGeneral(nn.Module):
             (self.features,) if isinstance(self.features, int) else tuple(self.features)
         )
         axis = _normalize_axes(self.axis, x.ndim)
-        kernel_shape = tuple(x.shape[a] for a in axis) + features
+        in_shape = tuple(x.shape[a] for a in axis)
+        if self.transpose_kernel:
+            kernel_shape = features + in_shape
+        else:
+            kernel_shape = in_shape + features
         assert len(self.kernel_axes) == len(kernel_shape), (
             f"kernel_axes {self.kernel_axes} must name every dim of "
             f"{kernel_shape}"
@@ -70,7 +80,12 @@ class DenseGeneral(nn.Module):
         )
         kernel = kernel.astype(self.dtype)
         x = x.astype(self.dtype)
-        contract = tuple(range(len(axis)))
+        if self.transpose_kernel:
+            contract = tuple(
+                range(len(features), len(features) + len(axis))
+            )
+        else:
+            contract = tuple(range(len(axis)))
         out = jax.lax.dot_general(
             x, kernel, ((axis, contract), ((), ()))
         )

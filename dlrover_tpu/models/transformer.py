@@ -69,6 +69,9 @@ class TransformerConfig:
     # operand layouts otherwise flip the whole layer seq-minor and the MLP
     # matmuls lower to ~40%-MXU windowed emitters (see ops/layout_pin.py).
     pin_attn_layouts: bool = False
+    # Store the MLP wo kernel transposed [d_model, d_ff] (emitter
+    # experiment, PROFILE.md r4).  Checkpoint-format change when True.
+    wo_transposed: bool = False
     remat: str = "none"            # one of _REMAT_POLICIES below: "none",
                                    # "dots", "dots_no_batch", "full",
                                    # "attn_out", "branch_out", "flash_res",
@@ -147,6 +150,7 @@ class Mlp(nn.Module):
     use_bias: bool
     dtype: Any
     param_dtype: Any
+    wo_transposed: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -173,10 +177,14 @@ class Mlp(nn.Module):
             h = nn.gelu(h)
         return layers.DenseGeneral(
             d,
-            kernel_axes=(lr.MLP, lr.EMBED),
+            kernel_axes=(
+                (lr.EMBED, lr.MLP) if self.wo_transposed
+                else (lr.MLP, lr.EMBED)
+            ),
             use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            transpose_kernel=self.wo_transposed,
             name="wo",
         )(h)
 
@@ -240,6 +248,7 @@ class Block(nn.Module):
                 use_bias=cfg.use_bias,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
+                wo_transposed=cfg.wo_transposed,
                 name="mlp",
             )(y)
         # Under the "branch_out" policy the backward rebuilds the residual

@@ -204,7 +204,7 @@ def _block_mask(causal, q_start, kv_start, seg_q_ref, seg_kv_ref,
 
 
 def _recompute_p_ds(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     seg_q_ref, seg_kv_ref,
     *, causal, scale, q_start, kv_start, block_q, block_kv,
 ):
@@ -212,13 +212,21 @@ def _recompute_p_ds(
 
     The softmax recompute from lse and its masking MUST be identical across
     the dq / dkv / fused kernels — one traced helper keeps them in sync.
+
+    ``delta = rowsum(o * do)`` is computed IN-KERNEL from the o block (the
+    head dim is whole per block, so the row sum is exact) instead of in a
+    separate XLA fusion — that fusion plus the padded [B,H,S,STAT] delta
+    array cost ~1 ms/layer of pure HBM traffic at bench shapes.
     """
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
     do = do_ref[0, 0]
     lse = lse_ref[0, 0][:, 0][:, None]
-    delta = delta_ref[0, 0][:, 0][:, None]
+    delta = jnp.sum(
+        o_ref[0, 0].astype(jnp.float32) * do.astype(jnp.float32),
+        axis=1, keepdims=True,
+    )
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -236,7 +244,7 @@ def _recompute_p_ds(
 
 
 def _bwd_dq_kernel(
-    seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     dq_ref, dq_acc_ref,
     *, causal: bool, scale: float, block_q: int, block_kv: int,
 ):
@@ -253,7 +261,7 @@ def _bwd_dq_kernel(
     @pl.when(run)
     def _compute():
         _, ds = _recompute_p_ds(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
             seg_q_ref, seg_kv_ref,
             causal=causal, scale=scale, q_start=q_start, kv_start=kv_start,
             block_q=block_q, block_kv=block_kv,
@@ -268,7 +276,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
     *, causal: bool, scale: float, block_q: int, block_kv: int,
 ):
@@ -286,7 +294,7 @@ def _bwd_dkv_kernel(
     @pl.when(run)
     def _compute():
         p, ds = _recompute_p_ds(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
             seg_q_ref, seg_kv_ref,
             causal=causal, scale=scale, q_start=q_start, kv_start=kv_start,
             block_q=block_q, block_kv=block_kv,
@@ -308,7 +316,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_fused_kernel(
-    seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    seg_q_ref, seg_kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
     dq_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
     *, causal: bool, scale: float, block_q: int, block_kv: int,
 ):
@@ -339,7 +347,7 @@ def _bwd_fused_kernel(
     @pl.when(run)
     def _compute():
         p, ds = _recompute_p_ds(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
             seg_q_ref, seg_kv_ref,
             causal=causal, scale=scale, q_start=q_start, kv_start=kv_start,
             block_q=block_q, block_kv=block_kv,
@@ -373,11 +381,7 @@ def _flash_bwd_fused(
     group = hq // hkv
     nq, nk = sq // block_q, skv // block_kv
 
-    delta = jnp.sum(
-        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
-    )  # [B,Hq,S]
     lse_l = jnp.broadcast_to(lse[..., None], (*lse.shape, _STAT))
-    delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, _STAT))
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(
@@ -406,7 +410,7 @@ def _flash_bwd_fused(
                 (1, 1, block_q, _STAT), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_q, _STAT), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
+                (1, 1, block_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
             ),
         ],
         out_specs=[
@@ -430,7 +434,7 @@ def _flash_bwd_fused(
             jax.ShapeDtypeStruct((b, hq, skv, d), v.dtype),
         ],
         interpret=_interpret(),
-    )(seg_q, seg_kv, q, k, v, do, lse_l, delta_l)
+    )(seg_q, seg_kv, q, k, v, do, lse_l, o)
     if group > 1:
         dk = dk.reshape(b, hkv, group, skv, d).sum(axis=2).astype(k.dtype)
         dv = dv.reshape(b, hkv, group, skv, d).sum(axis=2).astype(v.dtype)
@@ -446,13 +450,9 @@ def _flash_bwd(
     group = hq // hkv
     nq, nk = sq // block_q, skv // block_kv
 
-    delta = jnp.sum(
-        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
-    )  # [B,Hq,S]
     lse_l = jnp.broadcast_to(lse[..., None], (*lse.shape, _STAT))
-    delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, _STAT))
 
-    common_in = [seg_q, seg_kv, q, k, v, do, lse_l, delta_l]
+    common_in = [seg_q, seg_kv, q, k, v, do, lse_l, o]
     lane_spec_q = pl.BlockSpec(
         (1, 1, block_q, _STAT), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
     )
@@ -480,7 +480,9 @@ def _flash_bwd(
                 (1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
             ),
             lane_spec_q,
-            lane_spec_q,
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
+            ),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
@@ -518,7 +520,7 @@ def _flash_bwd(
                 (1, 1, block_q, _STAT), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_q, _STAT), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
+                (1, 1, block_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
             ),
         ],
         out_specs=[

@@ -51,6 +51,11 @@ def initialize(force: bool = False):
     if not under_agent():
         logger.info("no agent environment; single-process jax")
         return
+    # Hang-diagnosis seam: the agent can SIGUSR1 this process for an
+    # all-thread Python stack dump (agent/stack_collector.py).
+    from dlrover_tpu.agent.stack_collector import install_stack_dump_handler
+
+    install_stack_dump_handler()
     n = num_processes()
     if n <= 1 and not force:
         return

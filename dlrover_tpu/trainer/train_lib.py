@@ -99,6 +99,14 @@ def make_optimizer(
         opt = q8_adam(
             schedule, b1=b1, b2=b2, weight_decay=weight_decay, **kwargs
         )
+    elif name == "q4_adam":
+        # 4-bit packed moments (1.25 bytes/param; ref q4 states in
+        # ``low_bit/functional.py``).
+        from dlrover_tpu.ops.quantization import q4_adam
+
+        opt = q4_adam(
+            schedule, b1=b1, b2=b2, weight_decay=weight_decay, **kwargs
+        )
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if grad_clip:

@@ -79,6 +79,14 @@ class ElasticLaunchConfig:
     local_world_size: int = 0  # 0 -> discover (local chip count)
     heartbeat_interval: float = 15.0
     resource_report_interval: float = 30.0
+    # Device-init watchdog (VERDICT r4 #2b): a freshly started trainer
+    # that produces no first step report within this bound is stuck below
+    # Python (wedged device relay, hung PJRT init) — a failure mode the
+    # generic heartbeat can NEVER catch, because the agent process itself
+    # stays healthy and keeps heartbeating while the trainer hangs at
+    # backend init.  0 disables.  Generous default: first-compile of a
+    # multi-B model is legitimately minutes.
+    device_init_timeout: float = 900.0
 
 
 class RunResult(Enum):
@@ -174,6 +182,11 @@ class ElasticAgent:
         self._log_path: Optional[str] = None
         self._log_pump: Optional[threading.Thread] = None
         self._log_pump_stop = threading.Event()
+        # Device-init watchdog state, reset per worker start.
+        self._worker_started_wallclock = 0.0
+        self._first_step_confirmed = False
+        self._last_log_size = -1
+        self._last_activity_wallclock = 0.0
 
     def _metrics_file(self) -> str:
         """Trainer->agent device-telemetry handoff file (ref
@@ -325,8 +338,52 @@ class ElasticAgent:
             daemon=True,
         )
         self._log_pump.start()
+        self._worker_started_wallclock = time.time()
+        self._first_step_confirmed = False
+        self._last_log_size = -1
+        self._last_activity_wallclock = time.time()
         self.client.report_event("started")
         return rdzv
+
+    # -- device-init watchdog -------------------------------------------------
+
+    def _device_init_hung(self) -> bool:
+        """True when the live trainer has gone fully silent for
+        ``device_init_timeout`` before producing any step evidence.
+
+        Step evidence is the trainer-side metrics file (written by
+        ``write_device_metrics`` on every report step): an mtime at/after
+        this round's start means the loop is stepping, and the check
+        latches off for the round.  Until then, ANY trainer output
+        (captured log growth) counts as liveness — so a healthy custom
+        trainer that never integrates the metrics seam is not killed as
+        long as it says anything, and the watchdog only fires on the real
+        signature of a wedged device init: a process that stops emitting
+        entirely, below Python, before its first step.  A later slow
+        stretch is the master hang detector's job (it sees step reports);
+        this covers the window the master is blind to (ref
+        ``check_training_hang_operator.py:26-60`` covers the stepping
+        case; nothing in the reference covers pre-first-step).
+        """
+        timeout = self.config.device_init_timeout
+        if not timeout or self._first_step_confirmed:
+            return False
+        try:
+            mtime = os.path.getmtime(self._metrics_file())
+        except OSError:
+            mtime = 0.0
+        now = time.time()
+        if mtime >= self._worker_started_wallclock:
+            self._first_step_confirmed = True
+            return False
+        try:
+            log_size = os.path.getsize(self._log_path)
+        except (OSError, TypeError):
+            log_size = 0
+        if log_size != self._last_log_size:
+            self._last_log_size = log_size
+            self._last_activity_wallclock = now
+        return now - self._last_activity_wallclock > timeout
 
     def _pump_output(self, stream, log_path: str, stop_flag):
         """Tee trainer output to our stdout + an unbuffered log file.
@@ -492,6 +549,46 @@ class ElasticAgent:
                     if self._saver is not None:
                         self._saver.save_shm_to_storage()
                     self._restart_workers()
+                    continue
+                if self._device_init_hung():
+                    # Stuck below Python before its first step: capture
+                    # stacks for the diagnosis, then go through the
+                    # restart/budget machinery instead of hanging with it.
+                    stacks = self.dump_trainer_stacks(timeout_s=3.0)
+                    error = (
+                        "device-init-hang: trainer produced no step within "
+                        f"{self.config.device_init_timeout:.0f}s of start"
+                    )
+                    if stacks:
+                        error += (
+                            "\n--- trainer stacks ---\n"
+                            + "\n".join(stacks.splitlines()[:60])
+                        )
+                    logger.error("%s", error)
+                    try:
+                        action = self.client.report_failure(
+                            error, exit_code=0, level="process",
+                            restart_count=self._restart_count,
+                        )
+                    except ConnectionError:
+                        action = (
+                            "restart"
+                            if self._restart_count < self.config.max_restarts
+                            else "stop"
+                        )
+                    if action == "restart" and (
+                        self._restart_count < self.config.max_restarts
+                    ):
+                        self._restart_workers()
+                        continue
+                    try:
+                        self.client.report_event(
+                            "failed", "device-init-hang"
+                        )
+                    except ConnectionError:
+                        pass  # master down too; still reap the trainer
+                    self._stop_workers(sig=signal.SIGKILL, grace=5.0)
+                    return RunResult.FAILED
                 continue
             if code == 0:
                 self.client.report_event("succeeded")

@@ -174,7 +174,11 @@ def _knob_space(
         micro = [pipe, 2 * pipe, 4 * pipe]
     else:
         micro = [0]
-    dcn = [False, True] if multihost else [False]
+    # The DCN knob is a kernel-level transport choice like flash blocks /
+    # CE chunking: gate it on the same opt-in so estimate-only runs with
+    # search_kernels=False never have their mesh ranking skewed by an
+    # optimization no caller would apply.
+    dcn = [False, True] if (search_kernels and multihost) else [False]
     return [
         {"flash_block": fb, "ce_chunks": ce, "microbatches": mb,
          "quantized_dcn": q}
@@ -207,6 +211,15 @@ def enumerate_candidates(
     """
     heads = config.num_heads
     seq_len = seq_len or config.max_seq_len
+    # Validate up front, identically on every host: a policy without a
+    # broadcast code raising only on the hosts whose measured best uses it
+    # would leave the others hung in broadcast_one_to_all.
+    uncoded = [r for r in remat_policies if r not in _REMAT_CODES]
+    if uncoded:
+        raise ValueError(
+            f"remat policies {uncoded} have no _REMAT_CODES entry; "
+            "multihost choice broadcast would diverge"
+        )
     candidates: List[Candidate] = []
     seen = set()
     for tensor in _divisors(n_devices):
@@ -467,7 +480,8 @@ def _knob_neighbors(
 
 
 _REMAT_CODES = {"none": 0, "full": 1, "dots": 2, "attn_out": 3,
-                "branch_out": 4}
+                "branch_out": 4, "flash_only": 5, "flash_res": 6,
+                "dots_no_batch": 7}
 
 
 def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
@@ -475,9 +489,17 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
     from jax.experimental import multihost_utils
 
     p = best.parallel
+    if best.remat not in _REMAT_CODES:
+        # Silently encoding an unknown policy as -1 would make non-source
+        # hosts decode it to their own local best — divergent compiled
+        # programs hang the first collective.  Fail loudly instead.
+        raise ValueError(
+            f"remat policy {best.remat!r} has no broadcast code; add it to "
+            "_REMAT_CODES"
+        )
     key = np.asarray(
         [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
-         _REMAT_CODES.get(best.remat, -1), best.global_batch_size,
+         _REMAT_CODES[best.remat], best.global_batch_size,
          best.flash_block[0], best.flash_block[1], best.ce_chunks,
          best.microbatches, int(best.quantized_dcn)],
         np.int64,
@@ -490,7 +512,12 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         data=int(agreed[0]), fsdp=int(agreed[1]), pipe=int(agreed[2]),
         expert=int(agreed[3]), seq=int(agreed[4]), tensor=int(agreed[5]),
     )
-    remat = codes.get(int(agreed[6]), best.remat)
+    if int(agreed[6]) not in codes:
+        raise ValueError(
+            f"broadcast remat code {int(agreed[6])} unknown to this host "
+            "(version skew between hosts?)"
+        )
+    remat = codes[int(agreed[6])]
     knobs = dict(
         global_batch_size=int(agreed[7]),
         flash_block=(int(agreed[8]), int(agreed[9])),

@@ -107,13 +107,19 @@ class ElasticDataLoader:
                 yield i, []
                 i += 1
         elif isinstance(self.source, ShardingClient):
+            from dlrover_tpu.data.sharding_client import task_sample_indices
+
             while True:
                 task = self.source.fetch_shard()
                 if task is None:
                     return
-                for index in range(task.start, task.end - 1):
+                indices = list(task_sample_indices(task))
+                if not indices:
+                    self.source.report_shard_done(task)
+                    continue
+                for index in indices[:-1]:
                     yield index, []
-                yield task.end - 1, [task]
+                yield indices[-1], [task]
         else:
             for index in self.source:
                 yield index, []

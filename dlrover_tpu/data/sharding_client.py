@@ -20,6 +20,17 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.messages import DatasetShardParams, ShardTask
 
 
+def task_sample_indices(task: ShardTask):
+    """The sample indices a shard denotes: explicit ``record_indices``
+    (the shuffled text splitter's per-shard permutation slice) win over
+    the [start, end) range — every consumer must resolve shards through
+    this, or master-side sample shuffling silently becomes a no-op."""
+    indices = getattr(task, "record_indices", None)
+    if indices:
+        return list(indices)
+    return range(task.start, task.end)
+
+
 class ShardingClient:
     """Fetch/ack shard tasks for one dataset."""
 
@@ -67,7 +78,7 @@ class ShardingClient:
             task = self.fetch_shard()
             if task is None:
                 return
-            yield from range(task.start, task.end)
+            yield from task_sample_indices(task)
             self.report_shard_done(task)
 
 
@@ -89,7 +100,7 @@ class IndexShardingClient(ShardingClient):
                 if task is None:
                     return None
                 self._inflight.append(task)
-                self._pending.extend(range(task.start, task.end))
+                self._pending.extend(task_sample_indices(task))
             return self._pending.popleft()
 
     def report_batch_done(self, batch_size: int):

@@ -85,5 +85,32 @@ class TextShardReader:
             for ln in lines
         ]
 
+    def read_line(self, index: int) -> str:
+        """One line by number: a seek + bounded read, never a top scan."""
+        if not 0 <= index < self.num_lines:
+            raise IndexError(
+                f"line {index} out of range [0, {self.num_lines})"
+            )
+        self._file.seek(int(self._offsets[index]))
+        blob = self._file.read(
+            int(self._offsets[index + 1] - self._offsets[index])
+        )
+        if blob.endswith(b"\n"):
+            blob = blob[:-1]
+        if blob.endswith(b"\r"):
+            blob = blob[:-1]
+        return blob.decode("utf-8", errors="replace")
+
+    def read_task(self, task) -> List[str]:
+        """Resolve a master ShardTask through the one canonical
+        resolution (``task_sample_indices``); contiguous ranges keep the
+        single-blob fast path."""
+        from dlrover_tpu.data.sharding_client import task_sample_indices
+
+        indices = task_sample_indices(task)
+        if isinstance(indices, range):
+            return self.read_shard(indices.start, indices.stop)
+        return [self.read_line(i) for i in indices]
+
     def close(self):
         self._file.close()

@@ -233,6 +233,49 @@ inline void lamb_row(float* w, float* m, float* v, const float* g,
   }
 }
 
+// Rectified Adam (ref tfplus training_ops.cc RectifiedAdam group apply):
+// warms up through the un-adapted SGD-with-momentum regime until the
+// variance estimate's rectification term r_t is defined (rho_t > 4).
+inline void radam_row(float* w, float* m, float* v, const float* g,
+                      int64_t dim, float lr, float b1, float b2, float eps,
+                      float wd, float bias1, float bias2, float rho_inf,
+                      float rho_t) {
+  float rect = -1.0f;
+  if (rho_t > 4.0f) {
+    rect = sqrtf(((rho_t - 4.0f) * (rho_t - 2.0f) * rho_inf) /
+                 ((rho_inf - 4.0f) * (rho_inf - 2.0f) * rho_t));
+  }
+  for (int64_t d = 0; d < dim; ++d) {
+    m[d] = b1 * m[d] + (1.0f - b1) * g[d];
+    v[d] = b2 * v[d] + (1.0f - b2) * g[d] * g[d];
+    float m_hat = m[d] / bias1;
+    float update;
+    if (rect > 0.0f) {
+      float v_hat = sqrtf(v[d] / bias2);
+      update = rect * m_hat / (v_hat + eps);
+    } else {
+      update = m_hat;
+    }
+    w[d] -= lr * (update + wd * w[d]);
+  }
+}
+
+// AdaHessian (ref tfplus AdaDQH/AdaHessian group semantics): the second
+// moment tracks the squared HESSIAN diagonal estimate (Hutchinson trace
+// probe, computed by the caller), not the squared gradient — curvature-
+// scaled steps where Adam's are gradient-magnitude-scaled.
+inline void adahessian_row(float* w, float* m, float* v, const float* g,
+                           const float* h, int64_t dim, float lr, float b1,
+                           float b2, float eps, float wd, float bias1,
+                           float bias2) {
+  for (int64_t d = 0; d < dim; ++d) {
+    m[d] = b1 * m[d] + (1.0f - b1) * g[d];
+    v[d] = b2 * v[d] + (1.0f - b2) * h[d] * h[d];
+    float update = (m[d] / bias1) / (sqrtf(v[d] / bias2) + eps);
+    w[d] -= lr * (update + wd * w[d]);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -375,6 +418,46 @@ void kv_apply_group_lamb(void* handle, const int64_t* upd_keys, int64_t n,
     if (!row) continue;
     lamb_row(row, row + s->dim, row + 2 * s->dim, grads + i * s->dim,
              s->dim, lr, b1, b2, eps, weight_decay, bias1, bias2);
+  }
+}
+
+// Group-sparse Rectified Adam (ref RectifiedAdam group apply): s0 = m,
+// s1 = v; the rectification schedule is a function of t alone.
+void kv_apply_group_radam(void* handle, const int64_t* upd_keys, int64_t n,
+                          const float* grads, float lr, float b1, float b2,
+                          float eps, float weight_decay, int64_t t) {
+  Store* s = static_cast<Store*>(handle);
+  float bias1 = 1.0f - powf(b1, static_cast<float>(t));
+  float bias2 = 1.0f - powf(b2, static_cast<float>(t));
+  float rho_inf = 2.0f / (1.0f - b2) - 1.0f;
+  float b2t = powf(b2, static_cast<float>(t));
+  float rho_t =
+      rho_inf - 2.0f * static_cast<float>(t) * b2t / (1.0f - b2t);
+  for (int64_t i = 0; i < n; ++i) {
+    float* row = s->row_for(static_cast<uint64_t>(upd_keys[i]));
+    if (!row) continue;
+    radam_row(row, row + s->dim, row + 2 * s->dim, grads + i * s->dim,
+              s->dim, lr, b1, b2, eps, weight_decay, bias1, bias2, rho_inf,
+              rho_t);
+  }
+}
+
+// Group-sparse AdaHessian: grads + caller-computed Hessian-diagonal rows
+// (same [n, dim] layout); s0 = m, s1 = v over h^2.
+void kv_apply_group_adahessian(void* handle, const int64_t* upd_keys,
+                               int64_t n, const float* grads,
+                               const float* hessian, float lr, float b1,
+                               float b2, float eps, float weight_decay,
+                               int64_t t) {
+  Store* s = static_cast<Store*>(handle);
+  float bias1 = 1.0f - powf(b1, static_cast<float>(t));
+  float bias2 = 1.0f - powf(b2, static_cast<float>(t));
+  for (int64_t i = 0; i < n; ++i) {
+    float* row = s->row_for(static_cast<uint64_t>(upd_keys[i]));
+    if (!row) continue;
+    adahessian_row(row, row + s->dim, row + 2 * s->dim, grads + i * s->dim,
+                   hessian + i * s->dim, s->dim, lr, b1, b2, eps,
+                   weight_decay, bias1, bias2);
   }
 }
 

@@ -77,6 +77,14 @@ def _load_native() -> Optional[ctypes.CDLL]:
             c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float, c.c_float,
             c.c_float, c.c_float, i64,
         ]
+        lib.kv_apply_group_radam.argtypes = [
+            c.c_void_p, i64p, i64, f32p, c.c_float, c.c_float, c.c_float,
+            c.c_float, c.c_float, i64,
+        ]
+        lib.kv_apply_group_adahessian.argtypes = [
+            c.c_void_p, i64p, i64, f32p, f32p, c.c_float, c.c_float,
+            c.c_float, c.c_float, c.c_float, i64,
+        ]
         lib.kv_export.restype = i64
         lib.kv_export.argtypes = [
             c.c_void_p, u32, i64p, f32p, f32p, f32p, u32p, u32p, i64,
@@ -302,6 +310,83 @@ class KVStore:
                 u_norm = float(np.linalg.norm(u))
                 ratio = w_norm / u_norm if w_norm > 0 and u_norm > 0 else 1.0
                 row[0] -= lr * ratio * u
+
+    def apply_group_radam(self, keys: np.ndarray, grads: np.ndarray,
+                          lr: float, b1: float = 0.9, b2: float = 0.999,
+                          eps: float = 1e-8, weight_decay: float = 0.0,
+                          t: int = 1):
+        """Sparse Rectified Adam (s0 = m, s1 = v): un-adapted momentum
+        until the variance rectifier is defined (rho_t > 4); ref tfplus
+        ``RectifiedAdam`` group apply."""
+        keys, grads = self._check_grads(keys, grads)
+        with self._mu:
+            if self._lib:
+                self._lib.kv_apply_group_radam(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(grads, ctypes.c_float), lr, b1, b2, eps,
+                    weight_decay, t,
+                )
+                return
+            bias1 = 1.0 - b1 ** t
+            bias2 = 1.0 - b2 ** t
+            rho_inf = 2.0 / (1.0 - b2) - 1.0
+            b2t = b2 ** t
+            rho_t = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+            rect = None
+            if rho_t > 4.0:
+                rect = float(np.sqrt(
+                    ((rho_t - 4.0) * (rho_t - 2.0) * rho_inf)
+                    / ((rho_inf - 4.0) * (rho_inf - 2.0) * rho_t)
+                ))
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    continue
+                g = grads[i]
+                row[1] = b1 * row[1] + (1 - b1) * g
+                row[2] = b2 * row[2] + (1 - b2) * g * g
+                m_hat = row[1] / bias1
+                if rect is not None:
+                    update = rect * m_hat / (np.sqrt(row[2] / bias2) + eps)
+                else:
+                    update = m_hat
+                row[0] -= lr * (update + weight_decay * row[0])
+
+    def apply_group_adahessian(self, keys: np.ndarray, grads: np.ndarray,
+                               hessian: np.ndarray, lr: float,
+                               b1: float = 0.9, b2: float = 0.999,
+                               eps: float = 1e-8,
+                               weight_decay: float = 0.0, t: int = 1):
+        """Sparse AdaHessian (s0 = m, s1 = v over the squared Hessian
+        diagonal): ``hessian`` rows come from the caller's Hutchinson
+        probe; ref tfplus AdaDQH/AdaHessian group semantics."""
+        keys, grads = self._check_grads(keys, grads)
+        hessian = np.ascontiguousarray(hessian, np.float32)
+        if hessian.shape != grads.shape:
+            # Not an assert: the native path would read past the buffer.
+            raise ValueError(
+                f"hessian shape {hessian.shape} != grads {grads.shape}"
+            )
+        with self._mu:
+            if self._lib:
+                self._lib.kv_apply_group_adahessian(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                    _ptr(grads, ctypes.c_float),
+                    _ptr(hessian, ctypes.c_float), lr, b1, b2, eps,
+                    weight_decay, t,
+                )
+                return
+            bias1 = 1.0 - b1 ** t
+            bias2 = 1.0 - b2 ** t
+            for i, key in enumerate(keys.tolist()):
+                row = self._py.get(key)
+                if row is None:
+                    continue
+                g, h = grads[i], hessian[i]
+                row[1] = b1 * row[1] + (1 - b1) * g
+                row[2] = b2 * row[2] + (1 - b2) * h * h
+                update = (row[1] / bias1) / (np.sqrt(row[2] / bias2) + eps)
+                row[0] -= lr * (update + weight_decay * row[0])
 
     # -- export / import / eviction -------------------------------------------
 

@@ -31,7 +31,7 @@ from dlrover_tpu.embedding.store import KVStore
 class EmbeddingTable:
     #: group-sparse optimizers the store applies in-table (ref
     #: ``tfplus/kv_variable/ops/training_ops.cc`` optimizer-op family)
-    OPTIMIZERS = ("adam", "adagrad", "ftrl", "lamb")
+    OPTIMIZERS = ("adam", "adagrad", "ftrl", "lamb", "radam", "adahessian")
 
     def __init__(
         self,
@@ -101,12 +101,33 @@ class EmbeddingTable:
         )
         return rows, unique, inverse.astype(np.int32)
 
-    def apply_gradients(self, unique_keys: np.ndarray, grad_rows) -> None:
+    def apply_gradients(
+        self, unique_keys: np.ndarray, grad_rows, hessian_rows=None
+    ) -> None:
         """Group-sparse update on the rows ``lookup`` returned this step,
-        with the optimizer chosen at construction."""
+        with the optimizer chosen at construction.  ``hessian_rows``
+        (same shape as the grads) is required by ``adahessian`` — the
+        caller's Hutchinson diagonal estimate."""
         self._adam_t += 1
         grads = np.asarray(grad_rows, np.float32)
-        if self.optimizer == "adam":
+        if self.optimizer == "adahessian":
+            if hessian_rows is None:
+                raise ValueError(
+                    "optimizer='adahessian' needs hessian_rows (the "
+                    "Hutchinson Hessian-diagonal estimate per row)"
+                )
+            self.store.apply_group_adahessian(
+                unique_keys, grads, np.asarray(hessian_rows, np.float32),
+                lr=self.learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay, t=self._adam_t,
+            )
+        elif self.optimizer == "radam":
+            self.store.apply_group_radam(
+                unique_keys, grads,
+                lr=self.learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay, t=self._adam_t,
+            )
+        elif self.optimizer == "adam":
             self.store.apply_group_adam(
                 unique_keys, grads,
                 lr=self.learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,

@@ -181,10 +181,19 @@ class RunningJobOptimizer:
         uplift_threshold: float = 1.1,
         degrade_threshold: float = 0.7,
         patience: int = 3,
+        stale_after_s: float = 3600.0,
     ):
         self.uplift_threshold = uplift_threshold
         self.degrade_threshold = degrade_threshold
         self.patience = patience
+        # Re-exploration bound (VERDICT r4 weak #4): a size whose newest
+        # sample is older than this is eligible for exploration again —
+        # one bad reading taken during a degraded window must not lock a
+        # size out forever (observe() only records at the CURRENT size,
+        # so stale history never refreshes on its own).  The reference
+        # re-optimizes on a timer regardless
+        # (ref ``job_auto_scaler.py:161-252``).
+        self.stale_after_s = stale_after_s
         self._obs: Dict[int, List[Observation]] = {}
         self._degraded_ticks = 0
 
@@ -207,6 +216,12 @@ class RunningJobOptimizer:
     def _best_speed(self, num_nodes: int) -> float:
         hist = self._obs.get(num_nodes, [])
         return max((o.speed for o in hist), default=0.0)
+
+    def _size_is_stale(self, num_nodes: int) -> bool:
+        """No sample at this size newer than ``stale_after_s``."""
+        hist = self._obs.get(num_nodes, [])
+        newest = max((o.timestamp for o in hist), default=0.0)
+        return time.time() - newest > self.stale_after_s
 
     def _recent_speed(self, num_nodes: int, k: int = 3) -> float:
         hist = self._obs.get(num_nodes, [])
@@ -272,14 +287,19 @@ class RunningJobOptimizer:
                 ),
                 confidence=0.8,
             )
-        # Explore: the ceiling is untested and we have a stable reading here.
+        # Explore: the next size up is untested — or every sample there
+        # has gone stale (e.g. measured once during a degraded window).
         if larger <= max_nodes and len(self._obs.get(current_nodes, [])) >= (
             self.patience
-        ) and self._best_speed(larger) == 0:
+        ) and (self._best_speed(larger) == 0 or self._size_is_stale(larger)):
+            why = (
+                "untested" if self._best_speed(larger) == 0
+                else f"stale > {self.stale_after_s:.0f}s"
+            )
             return ResourcePlan(
                 num_nodes=larger,
                 global_batch_size=0,
-                reason=f"exploring {larger} nodes (untested, ceiling "
+                reason=f"exploring {larger} nodes ({why}, ceiling "
                        f"{max_nodes})",
                 confidence=0.5,
             )

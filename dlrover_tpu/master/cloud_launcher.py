@@ -44,7 +44,9 @@ class TpuVmClient:
 
     Mirrors ``projects.locations.nodes`` of ``tpu.googleapis.com`` v2 at
     the granularity the launcher needs.  Implementations raise
-    ``CloudError`` on API failures.
+    ``CloudError`` on API failures.  Production:
+    :class:`dlrover_tpu.master.tpu_api.TpuVmHttpClient` (HTTP +
+    metadata-server auth); tests: :class:`FakeTpuVmClient`.
     """
 
     def create_node(self, name: str, accelerator_type: str,
@@ -154,6 +156,11 @@ class CloudNodeLauncher(NodeLauncher):
 
     CREATE_RETRIES = 3
     RETRY_BACKOFF_S = 2.0
+    # How long after a create lands before a dead list() reading for the
+    # node is believed: real-cloud list caches can keep serving the
+    # pre-delete record of the instance a relaunch just replaced for well
+    # over the master's 2-tick reconcile debounce.
+    LANDED_SETTLE_S = 60.0
 
     def __init__(
         self,
@@ -176,6 +183,15 @@ class CloudNodeLauncher(NodeLauncher):
         # orphan VM (retire racing the creator thread).
         self._wanted: set = set()
         self._wanted_mu = threading.Lock()
+        # Launch generations: each launch() bumps the node's generation;
+        # the creator thread marks the generation landed once its create
+        # call (or an already-live VM) is confirmed.  A dead VM seen by
+        # reconcile() is only the CURRENT one when the landed generation
+        # matches — otherwise it is the stale instance a relaunch is in
+        # the middle of replacing.
+        self._generation: Dict[int, int] = {}
+        self._landed_gen: Dict[int, int] = {}
+        self._landed_at: Dict[int, float] = {}
         self._stop = threading.Event()
         self._creator = threading.Thread(
             target=self._create_loop, name="tpu-vm-creator", daemon=True
@@ -201,7 +217,30 @@ class CloudNodeLauncher(NodeLauncher):
     def launch(self, node_id: int) -> None:
         with self._wanted_mu:
             self._wanted.add(node_id)
+            self._generation[node_id] = self._generation.get(node_id, 0) + 1
         self._queue.put(node_id)
+
+    def _mark_landed(self, node_id: int, gen: int):
+        # ``gen`` is the generation snapshot taken when the creator picked
+        # the node up — recording the generation current at COMPLETION time
+        # would mark an in-flight newer launch landed before its create
+        # ever ran.
+        with self._wanted_mu:
+            self._landed_gen[node_id] = gen
+            self._landed_at[node_id] = time.monotonic()
+
+    def vm_is_current(self, node_id: int) -> bool:
+        """True when the VM visible in the cloud belongs to the newest
+        launch() of this node (its create landed, no newer launch is
+        pending, and the landing has had ``LANDED_SETTLE_S`` to propagate
+        through the cloud's list() cache) — the reconcile disambiguator
+        for PENDING nodes."""
+        with self._wanted_mu:
+            gen = self._generation.get(node_id, 0)
+            if gen <= 0 or self._landed_gen.get(node_id) != gen:
+                return False
+            settled = time.monotonic() - self._landed_at.get(node_id, 0.0)
+            return settled > self.LANDED_SETTLE_S
 
     def delete(self, node_id: int) -> None:
         with self._wanted_mu:
@@ -239,6 +278,8 @@ class CloudNodeLauncher(NodeLauncher):
     def _create_with_retry(self, node_id: int):
         name = self.instance_name(node_id)
         last_err: Optional[CloudError] = None
+        with self._wanted_mu:
+            gen = self._generation.get(node_id, 0)
         for attempt in range(self.CREATE_RETRIES):
             with self._wanted_mu:
                 if node_id not in self._wanted:
@@ -258,6 +299,7 @@ class CloudNodeLauncher(NodeLauncher):
                 # report a healthy VM as failed.
                 logger.info("cloud launcher: %s already %s", name,
                             existing["state"])
+                self._mark_landed(node_id, gen)
                 return
             if existing is not None:
                 # A dead VM (PREEMPTED/TERMINATED) holds the name on some
@@ -279,6 +321,7 @@ class CloudNodeLauncher(NodeLauncher):
                 )
                 logger.info("cloud launcher: creating %s (%s)", name,
                             self.accelerator_type)
+                self._mark_landed(node_id, gen)
                 return
             except CloudError as e:
                 last_err = e
@@ -293,6 +336,7 @@ class CloudNodeLauncher(NodeLauncher):
         if existing is not None and existing["state"] in (
             TpuVmState.CREATING, TpuVmState.READY
         ):
+            self._mark_landed(node_id, gen)
             return
         logger.error("cloud launcher: giving up on %s (%s)", name, last_err)
         if self.node_failed_hook is not None:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.auto_scaler import JobAutoScaler
@@ -29,6 +29,11 @@ from dlrover_tpu.master.task_manager import TaskManager
 
 class JobMaster:
     CONTROL_LOOP_INTERVAL = 10.0
+    # Consecutive reconcile ticks a PENDING node's current-generation VM
+    # must read dead before it is failed: one tick of grace absorbs cloud
+    # list() caches that serve the pre-delete record briefly after a
+    # replacement create lands.
+    PENDING_DEAD_TICKS = 2
 
     def __init__(
         self,
@@ -44,12 +49,14 @@ class JobMaster:
         auto_scale: bool = True,
         optimize_interval_s: float = 300.0,
         state_path: str = "",
+        brain_overrides: Optional[Dict[str, float]] = None,
     ):
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager()
         self.kv_store = KVStore()
         self.metrics = MetricsCollector()
         self._launcher = launcher
+        self._pending_dead_ticks: Dict[int, int] = {}
         self.node_manager = NodeManager(
             num_nodes=num_nodes,
             launcher=launcher,
@@ -68,7 +75,9 @@ class JobMaster:
             retire_hook=self._handle_node_retired,
             # Observation-driven sizing only makes sense with an elastic
             # range; a fixed-size job gets the repair loop alone.
-            optimizer=RunningJobOptimizer()
+            # brain_overrides: the job spec's [brain] thresholds
+            # (common/job_spec.py BrainSpec).
+            optimizer=RunningJobOptimizer(**(brain_overrides or {}))
             if (min_nodes and min_nodes < num_nodes) else None,
             optimize_interval_s=optimize_interval_s,
         ) if auto_scale else None
@@ -184,14 +193,12 @@ class JobMaster:
         from dlrover_tpu.master.node_manager import NodeStatus
 
         statuses = self.node_manager.statuses()
+        vm_is_current = getattr(self._launcher, "vm_is_current", None)
+        pending_dead_seen = set()
         for node_id, vm_state in reconcile().items():
             if vm_state in (TpuVmState.PREEMPTED, TpuVmState.TERMINATED):
-                # RUNNING only: a PENDING node's dead VM is the one we just
-                # replaced — real-cloud deletes are async and the stale VM
-                # lingers in list() for several ticks; re-failing it every
-                # tick would burn the whole relaunch budget on one
-                # preemption.
-                if statuses.get(node_id) == NodeStatus.RUNNING.value:
+                status = statuses.get(node_id)
+                if status == NodeStatus.RUNNING.value:
                     logger.warning(
                         "cloud reconcile: node %d VM is %s", node_id, vm_state
                     )
@@ -199,6 +206,35 @@ class JobMaster:
                         node_id, "failed", f"vm {vm_state}"
                     )
                     self._handle_node_death(node_id)
+                elif status == NodeStatus.PENDING.value and (
+                    vm_is_current is not None and vm_is_current(node_id)
+                ):
+                    # A VM preempted after its create landed but before the
+                    # agent's first heartbeat: without this the node stays
+                    # PENDING forever and wedges the rendezvous.  The
+                    # generation check keeps the old behavior for the stale
+                    # VM a relaunch is still replacing, and the
+                    # consecutive-tick debounce covers laggy cloud list()
+                    # caches that keep serving the pre-delete record for a
+                    # few ticks after the replacement create landed.
+                    pending_dead_seen.add(node_id)
+                    ticks = self._pending_dead_ticks.get(node_id, 0) + 1
+                    self._pending_dead_ticks[node_id] = ticks
+                    if ticks < self.PENDING_DEAD_TICKS:
+                        continue
+                    self._pending_dead_ticks.pop(node_id, None)
+                    logger.warning(
+                        "cloud reconcile: PENDING node %d's current VM "
+                        "died before first heartbeat (%s)",
+                        node_id, vm_state,
+                    )
+                    self.node_manager.report_event(
+                        node_id, "failed", f"vm {vm_state} before startup"
+                    )
+        # A healthy observation resets the debounce.
+        for node_id in list(self._pending_dead_ticks):
+            if node_id not in pending_dead_seen:
+                del self._pending_dead_ticks[node_id]
 
     def _run_diagnosis(self):
         """One inference-chain pass; execute what it prescribes (ref

@@ -102,6 +102,11 @@ class DatasetShardParams:
     shuffle: bool = False
     storage_type: str = "table"  # table | text | stream
     batch_size: int = 0
+    # OOM guard (ref ``dataset_splitter.py`` _MAX_SHARD_COUNT): an epoch
+    # producing more shards than this is split into subepochs of at most
+    # this many shards, so the master never materializes an unbounded
+    # shard list for a huge dataset.  0 = library default.
+    max_shard_count: int = 0
 
 
 @dataclasses.dataclass

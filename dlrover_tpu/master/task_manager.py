@@ -65,24 +65,130 @@ class DatasetSplitter:
         return self.epoch >= self.params.num_epochs
 
 
+_MAX_SHARD_COUNT = 50_000
+
+
 class TableDatasetSplitter(DatasetSplitter):
     """Record-range shards over a bounded table (capability ref
-    ``dataset_splitter.py:144`` TableDatasetSplitter): shards are [start,
-    end) row ranges, epochs reshuffle the shard ORDER (never the rows
-    inside a shard — a shard is the reader's sequential-scan unit)."""
+    ``dataset_splitter.py:144-257`` TableDatasetSplitter): shards are
+    [start, end) row ranges, epochs reshuffle the shard ORDER (never the
+    rows inside a shard — a shard is the reader's sequential-scan unit).
+
+    Huge datasets (ref ``_split_epoch_for_huge_dataset:180-196``): when
+    one epoch would materialize more than ``max_shard_count`` shards, the
+    epoch is split into subepochs covering consecutive row windows of at
+    most ``max_shard_count * shard_size`` rows each — the master holds a
+    bounded shard list regardless of dataset size.  ``num_epochs``
+    multiplies by the subepoch count internally; :meth:`user_epoch` maps
+    back to the caller's epoch numbering.
+    """
+
+    def __init__(self, params: DatasetShardParams):
+        super().__init__(params)
+        self.max_shard_count = params.max_shard_count or _MAX_SHARD_COUNT
+        p = self.params
+        shard_count = (p.dataset_size + p.shard_size - 1) // p.shard_size
+        self._subepochs_per_epoch = 0
+        self._total_epochs = p.num_epochs
+        if shard_count > self.max_shard_count:
+            self._subepochs_per_epoch = -(-shard_count // self.max_shard_count)
+            self._total_epochs = p.num_epochs * self._subepochs_per_epoch
+            logger.info(
+                "dataset %s: %d shards/epoch > max %d; splitting each "
+                "epoch into %d subepochs",
+                p.dataset_name, shard_count, self.max_shard_count,
+                self._subepochs_per_epoch,
+            )
+
+    def user_epoch(self) -> int:
+        """The caller-visible epoch (ref ``get_epoch:188``)."""
+        if self._subepochs_per_epoch:
+            return self.epoch // self._subepochs_per_epoch
+        return self.epoch
+
+    def _window(self) -> Tuple[int, int]:
+        """The [lo, hi) row range the current (sub)epoch covers."""
+        p = self.params
+        if not self._subepochs_per_epoch:
+            return 0, p.dataset_size
+        subepoch_idx = self.epoch % self._subepochs_per_epoch
+        subepoch_rows = self.max_shard_count * p.shard_size
+        lo = subepoch_idx * subepoch_rows
+        return lo, min(lo + subepoch_rows, p.dataset_size)
+
+    def create_shards(self) -> List[ShardTask]:
+        p = self.params
+        if not self._subepochs_per_epoch:
+            return super().create_shards()
+        lo, hi = self._window()
+        order = list(range(lo, hi, p.shard_size))
+        if p.shuffle:
+            import random
+
+            random.Random(self.epoch).shuffle(order)
+        shards = [
+            ShardTask(
+                dataset_name=p.dataset_name,
+                start=start,
+                end=min(start + p.shard_size, hi),
+                epoch=self.user_epoch(),
+            )
+            for start in order
+        ]
+        self.epoch += 1
+        return shards
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self._total_epochs
 
 
-class TextDatasetSplitter(DatasetSplitter):
-    """Line-range shards over a text file (capability ref
-    ``dataset_splitter.py:257`` TextDatasetSplitter): ``dataset_size`` is
-    the line count and a shard is a [start, end) line range.  The
-    trainer-side :class:`dlrover_tpu.data.text_shards.TextShardReader`
-    turns a shard into its lines via a byte-offset index, so workers never
-    scan the file from the top.
+class TextDatasetSplitter(TableDatasetSplitter):
+    """Line-index shards over a text file (capability ref
+    ``dataset_splitter.py:257-324`` TextDatasetSplitter): ``dataset_size``
+    is the line count.  Under ``shuffle`` each shard carries explicit
+    ``record_indices`` drawn from a permutation of line numbers —
+    sample-level shuffling, not just shard-order shuffling (a
+    line-addressable file has no sequential-scan constraint, unlike the
+    table case).  The trainer-side
+    :class:`dlrover_tpu.data.text_shards.TextShardReader` resolves
+    indices through its byte-offset index, so random line access costs
+    one seek, never a scan from the top.
 
-    Same range arithmetic as the table splitter — the split is identical,
-    the read path differs — but sharding is capped to whole lines so a
-    short final shard is emitted rather than padding past EOF."""
+    Inherits the table splitter's subepoch machinery, so the permutation
+    (and with it every shard's index payload and the master's shard-
+    checkpoint size) is bounded by the ``max_shard_count`` window — a
+    huge corpus shuffles within consecutive windows instead of
+    materializing an O(dataset_size) permutation in master memory.
+
+    Without ``shuffle`` shards are plain [start, end) line ranges read
+    sequentially, capped to whole lines so a short final shard is emitted
+    rather than padding past EOF.
+    """
+
+    def create_shards(self) -> List[ShardTask]:
+        p = self.params
+        if not p.shuffle:
+            return super().create_shards()
+        import random
+
+        lo, hi = self._window()
+        indices = list(range(lo, hi))
+        random.Random(self.epoch).shuffle(indices)
+        shards = []
+        for offset in range(0, hi - lo, p.shard_size):
+            start = lo + offset
+            end = min(start + p.shard_size, hi)
+            shards.append(
+                ShardTask(
+                    dataset_name=p.dataset_name,
+                    start=start,
+                    end=end,
+                    epoch=self.user_epoch(),
+                    record_indices=indices[offset:offset + (end - start)],
+                )
+            )
+        self.epoch += 1
+        return shards
 
 
 class StreamingDatasetSplitter(DatasetSplitter):
@@ -191,7 +297,7 @@ class DatasetManager:
         """Uncompleted = pending + doing; both restart from scratch on resume
         (ref ``task_manager.get_dataset_checkpoint:243``)."""
         todo = [
-            (t.start, t.end, t.epoch)
+            (t.start, t.end, t.epoch, t.record_indices)
             for t in list(self.pending)
             + [task for _, task, _ in self.doing.values()]
         ]
@@ -205,13 +311,18 @@ class DatasetManager:
     def restore(self, state: Dict):
         self.pending.clear()
         self.doing.clear()
-        for start, end, epoch in state.get("todo", []):
+        for entry in state.get("todo", []):
+            # Pre-r5 checkpoints carry (start, end, epoch) triples; newer
+            # ones append the text splitter's record_indices.
+            start, end, epoch = entry[:3]
+            indices = entry[3] if len(entry) > 3 else None
             shard = ShardTask(
                 task_id=self._next_task_id,
                 dataset_name=self.splitter.params.dataset_name,
                 start=start,
                 end=end,
                 epoch=epoch,
+                record_indices=list(indices) if indices else None,
             )
             self._next_task_id += 1
             self.pending.append(shard)

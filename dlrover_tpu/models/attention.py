@@ -132,6 +132,33 @@ def xla_attention(
     return out.reshape(b, sq, hq, d)
 
 
+def cached_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_positions: jax.Array,
+) -> jax.Array:
+    """Decode attention: queries at absolute ``q_positions`` [B, T]
+    against the full KV cache [B, L, H_kv, D]; cache slots past a query's
+    position (unwritten, or future) are masked.  GQA via grouped q."""
+    b, sq, hq, d = q.shape
+    cache_len, hkv = k.shape[1], k.shape[2]
+    group = hq // hkv
+    scale = d ** -0.5
+    qg = q.reshape(b, sq, hkv, group, d)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    kpos = jnp.arange(cache_len)
+    mask = kpos[None, None, None, None, :] <= (
+        q_positions[:, None, None, :, None]
+    )
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, sq, hq, d)
+
+
 class Attention(nn.Module):
     """Causal self-attention block with RoPE/GQA and SP-aware shardings."""
 
@@ -147,6 +174,11 @@ class Attention(nn.Module):
     fused_qkv: bool = True
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    # Autoregressive decoding: keep K/V in a "cache" collection of
+    # ``cache_len`` slots and attend incoming queries (prefill chunk or
+    # single decode token) against it.
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(
@@ -205,7 +237,34 @@ class Attention(nn.Module):
         if self.use_rope:
             q, k = layers.rotary_embedding(q, k, positions, self.rope_theta)
 
-        if self.attention_impl == "ring":
+        if self.decode:
+            b, t = x.shape[0], x.shape[1]
+            cache_len = self.cache_len
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, cache_len, self.num_kv_heads, self.head_dim), self.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, cache_len, self.num_kv_heads, self.head_dim), self.dtype,
+            )
+            index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            cur = index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(self.dtype), (0, cur, 0, 0)
+            )
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(self.dtype), (0, cur, 0, 0)
+            )
+            index.value = cur + t
+            q_positions = jnp.broadcast_to(positions, (b, t))
+            out = cached_attention(
+                q, cached_k.value, cached_v.value, q_positions
+            )
+        elif self.attention_impl == "ring":
             # Ring CP: sequence stays sharded; K/V stream around the ring.
             from dlrover_tpu.parallel.ring_attention import ring_attention
 

@@ -84,6 +84,22 @@ class TransformerConfig:
     # num_layers; microbatches default to the stage count.
     pipeline_stages: int = 1
     num_microbatches: int = 0
+    # Autoregressive decode mode (rl/generation.py): attention maintains a
+    # KV cache ("cache" collection, [B, max_seq_len, H_kv, hd] per layer)
+    # and attends single-token queries against it.  Param tree is
+    # UNCHANGED vs decode=False — the same weights serve training and
+    # generation.  Requires attention_impl="xla" (flash/ring kernels are
+    # seq-blocked; a 1-token query wants the einsum path) and no
+    # pipelining.
+    decode: bool = False
+    # Circular (interleaved-1F1B-equivalent) schedule: each device holds
+    # `interleave` layer chunks and every microbatch makes that many laps
+    # around the stage ring, cutting the bubble fraction from
+    # (S-1)/(M+S-1) to (S-1)/(vM+S-1) at v x the stage-handoff traffic
+    # (ref ``StageInterleaver.py``; measured +13.6% critical path at
+    # S=4/M=8, tools/pipeline_account.py).  Requires num_layers divisible
+    # by stages*interleave and microbatches >= stages.
+    pipeline_interleave: int = 1
 
     @property
     def resolved_kv_heads(self) -> int:
@@ -115,6 +131,36 @@ class TransformerConfig:
                 f"remat={self.remat!r} requires attention_impl='flash', got "
                 f"{self.attention_impl!r}"
             )
+        if self.decode:
+            if self.attention_impl != "xla":
+                raise ValueError(
+                    "decode=True requires attention_impl='xla' (got "
+                    f"{self.attention_impl!r}); the blocked flash/ring "
+                    "kernels have no single-token query path"
+                )
+            if self.pipeline_stages > 1:
+                raise ValueError("decode=True requires pipeline_stages=1")
+        if self.pipeline_interleave < 1:
+            raise ValueError("pipeline_interleave must be >= 1")
+        if self.pipeline_interleave > 1:
+            if self.pipeline_stages <= 1:
+                raise ValueError(
+                    "pipeline_interleave > 1 requires pipeline_stages > 1"
+                )
+            chunks = self.pipeline_stages * self.pipeline_interleave
+            if self.num_layers % chunks:
+                raise ValueError(
+                    f"num_layers {self.num_layers} not divisible by "
+                    f"stages*interleave {chunks}"
+                )
+            micro = self.num_microbatches or self.pipeline_stages
+            if micro < self.pipeline_stages:
+                raise ValueError(
+                    f"circular schedule needs microbatches >= stages "
+                    f"(got {micro} < {self.pipeline_stages}): lap L of a "
+                    "microbatch re-enters stage 0 only after lap L-1 "
+                    "cleared the ring"
+                )
 
     @property
     def resolved_d_ff(self) -> int:
@@ -218,6 +264,8 @@ class Block(nn.Module):
             fused_qkv=cfg.fused_qkv,
             flash_block_q=cfg.flash_block_q,
             flash_block_kv=cfg.flash_block_kv,
+            decode=cfg.decode,
+            cache_len=cfg.max_seq_len,
             name="attn",
         )(y, positions, segment_ids)
         if cfg.pin_attn_layouts:
@@ -354,7 +402,7 @@ class TransformerLM(nn.Module):
         elif cfg.scan_layers:
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,

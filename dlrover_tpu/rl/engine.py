@@ -1,0 +1,171 @@
+"""RLHF model engine: per-role meshes, placement, and phase machine.
+
+Capability ref: ``atorch/atorch/rl/model_engine/model_engine.py:1-496`` —
+the reference orchestrates actor/critic/ref/reward models with per-model
+acceleration strategies and a state machine switching between experience
+generation and RL training (``ModelEngineState``).
+
+TPU redesign: a "strategy" is a ``ParallelConfig`` + logical sharding
+rules, and moving a model between phases is a compile-time property of
+the jitted function used — there is no DeepSpeed hybrid-engine module
+shuttling.  Each role owns a mesh (possibly shaped differently: e.g. the
+actor tensor-sharded for generation latency while the critic runs pure
+data-parallel) and the engine pins params to the role's sharding and
+hands out jitted score/value functions compiled against it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Any, Callable, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+
+
+class EnginePhase(Enum):
+    """ref ``ModelEngineState`` (model_engine.py:29-33)."""
+
+    INIT = "init"
+    EXPERIENCE_GENERATION = "experience_generation"
+    RL_TRAINING = "rl_training"
+    EVALUATION = "evaluation"
+
+
+@dataclasses.dataclass
+class RoleSpec:
+    """One model role (ref ``config.model_keys`` entries)."""
+
+    parallel: ParallelConfig
+    trainable: bool = False
+    kind: str = "lm"  # "lm" | "critic"
+
+
+def default_roles(n_devices: int) -> Dict[str, RoleSpec]:
+    """actor/ref/critic on an all-data mesh (callers override to shard
+    roles differently)."""
+    dp = ParallelConfig(data=n_devices)
+    return {
+        "actor": RoleSpec(parallel=dp, trainable=True, kind="lm"),
+        "ref": RoleSpec(parallel=dp, trainable=False, kind="lm"),
+        "critic": RoleSpec(parallel=dp, trainable=True, kind="critic"),
+    }
+
+
+class RLHFEngine:
+    """Meshes, placement, and jitted scoring functions per role."""
+
+    def __init__(
+        self,
+        model_config: TransformerConfig,
+        roles: Optional[Dict[str, RoleSpec]] = None,
+        rules=None,
+        devices=None,
+    ):
+        from dlrover_tpu.rl.ppo import CriticModel
+
+        devices = devices if devices is not None else jax.devices()
+        self.model_config = model_config
+        self.rules = list(rules if rules is not None else lr.DEFAULT_RULES)
+        self.roles = roles or default_roles(len(devices))
+        self.phase = EnginePhase.INIT
+        self._role_ctx: Dict[str, Dict[str, Any]] = {}
+        dummy = jnp.zeros((1, model_config.max_seq_len), jnp.int32)
+        for name, spec in self.roles.items():
+            mesh = build_mesh(spec.parallel, devices=devices)
+            module = (
+                CriticModel(model_config) if spec.kind == "critic"
+                else TransformerLM(model_config)
+            )
+
+            def _init(rng, module=module):
+                return module.init(rng, dummy)["params"]
+
+            with jax.set_mesh(mesh), nn.logical_axis_rules(self.rules):
+                abstract = jax.eval_shape(_init, jax.random.PRNGKey(0))
+                specs = nn.get_partition_spec(abstract)
+                shardings = nn.logical_to_mesh_sharding(
+                    specs, mesh, self.rules
+                )
+            self._role_ctx[name] = {
+                "spec": spec,
+                "mesh": mesh,
+                "module": module,
+                "shardings": shardings,
+                "params": None,
+            }
+            logger.info(
+                "rl engine role %r: kind=%s mesh=%s trainable=%s",
+                name, spec.kind, dict(mesh.shape), spec.trainable,
+            )
+
+    # -- placement ---------------------------------------------------------
+
+    def place(self, role: str, params) -> Any:
+        """Pin a raw param pytree to the role's sharding (device_put)."""
+        ctx = self._role_ctx[role]
+        placed = jax.device_put(nn.meta.unbox(params), ctx["shardings"])
+        ctx["params"] = placed
+        return placed
+
+    def params(self, role: str):
+        return self._role_ctx[role]["params"]
+
+    def mesh(self, role: str):
+        return self._role_ctx[role]["mesh"]
+
+    def module(self, role: str):
+        return self._role_ctx[role]["module"]
+
+    def shardings(self, role: str):
+        return self._role_ctx[role]["shardings"]
+
+    def sync_roles(self, src: str, dst: str):
+        """Copy src's params onto dst's mesh/sharding (e.g. refresh the
+        frozen reference from the actor, or re-place actor weights for a
+        generation-shaped mesh — the reference's hybrid-engine module
+        swap collapses to one device_put under SPMD)."""
+        src_params = self._role_ctx[src]["params"]
+        if src_params is None:
+            raise ValueError(f"role {src!r} has no params placed")
+        return self.place(dst, src_params)
+
+    # -- phases ------------------------------------------------------------
+
+    def set_phase(self, phase: EnginePhase):
+        logger.info("rl engine: %s -> %s", self.phase.value, phase.value)
+        self.phase = phase
+
+    # -- jitted scoring ----------------------------------------------------
+
+    def logprob_fn(self, role: str) -> Callable:
+        """(params, tokens) -> per-token logprobs [B, S-1], compiled
+        against the role's mesh + sharding."""
+        from dlrover_tpu.rl.ppo import token_logprobs
+
+        ctx = self._role_ctx[role]
+        module = ctx["module"]
+
+        def fn(params, tokens):
+            logits, _ = module.apply({"params": params}, tokens)
+            return token_logprobs(logits, tokens)
+
+        with jax.set_mesh(ctx["mesh"]):
+            return jax.jit(fn, in_shardings=(ctx["shardings"], None))
+
+    def value_fn(self, role: str) -> Callable:
+        ctx = self._role_ctx[role]
+        module = ctx["module"]
+
+        def fn(params, tokens):
+            return module.apply({"params": params}, tokens)
+
+        with jax.set_mesh(ctx["mesh"]):
+            return jax.jit(fn, in_shardings=(ctx["shardings"], None))

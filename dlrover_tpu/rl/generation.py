@@ -1,0 +1,153 @@
+"""Generation backend: jitted KV-cache sampling on the training models.
+
+Capability ref: ``atorch/atorch/rl/inference_backend/vllm_backend.py``
+(the reference hands rollout generation to a vLLM engine beside the
+training job).
+
+TPU redesign: no second engine — the SAME param pytree that trains also
+generates, through a decode-mode instance of the model
+(``TransformerConfig(decode=True)``, identical param tree, plus a
+per-layer KV cache in the "cache" collection).  The whole rollout is ONE
+jitted program: a prefill call writes the prompt's K/V into the cache,
+then a ``lax.scan`` over decode steps feeds each sampled token back in —
+no per-token Python dispatch, static shapes throughout, so XLA pipelines
+the single-token matmuls and the sampler together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = full categorical
+    max_new_tokens: int = 16
+
+
+class GenerationBackend:
+    """Jitted prefill + decode-loop sampling for one model config.
+
+    ``generate(params, prompts, rng)`` -> (tokens [B, P+N], logprobs of
+    the sampled tokens [B, N]).  ``prompts`` must be a fixed-width int32
+    array (static prompt length; the engine re-jits per distinct shape,
+    which a fixed rollout pipeline hits once).
+    """
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        sampling: Optional[SamplingParams] = None,
+    ):
+        self.sampling = sampling or SamplingParams()
+        total = self.sampling.max_new_tokens
+        self.config = dataclasses.replace(
+            config,
+            decode=True,
+            attention_impl="xla",
+            remat="none",
+            pipeline_stages=1,
+            num_microbatches=0,
+            pipeline_interleave=1,
+        )
+        self.model = TransformerLM(self.config)
+        if total >= self.config.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens {total} must leave room for a prompt "
+                f"inside max_seq_len {self.config.max_seq_len}"
+            )
+        self._generate = jax.jit(self._generate_impl)
+
+    def _sample(self, logits: jax.Array, rng: jax.Array) -> jax.Array:
+        s = self.sampling
+        scaled = logits.astype(jnp.float32) / jnp.maximum(
+            s.temperature, 1e-6
+        )
+        if s.top_k:
+            kth = jnp.sort(scaled, axis=-1)[..., -s.top_k][..., None]
+            scaled = jnp.where(scaled >= kth, scaled, -1e15)
+        return jax.random.categorical(rng, scaled, axis=-1)
+
+    def _generate_impl(self, params, prompts, rng):
+        b, prompt_len = prompts.shape
+        n_new = self.sampling.max_new_tokens
+        if prompt_len + n_new > self.config.max_seq_len:
+            # Static shapes: this check runs at trace time.  Without it,
+            # decode writes past the cache clamp to the last slot and the
+            # output is silently garbage.
+            raise ValueError(
+                f"prompt {prompt_len} + max_new_tokens {n_new} exceeds "
+                f"max_seq_len {self.config.max_seq_len} (the KV cache)"
+            )
+
+        # Prefill: run the whole prompt once; the cache fills [0, P).
+        (logits, _aux), mutated = self.model.apply(
+            {"params": params},
+            prompts,
+            positions=jnp.arange(prompt_len)[None, :],
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        rng, step_rng = jax.random.split(rng)
+        first = self._sample(logits[:, -1], step_rng)
+
+        def decode_step(carry, step_rng):
+            cache, token, pos = carry
+            (step_logits, _), mutated = self.model.apply(
+                {"params": params, "cache": cache},
+                token[:, None],
+                positions=pos[:, None],
+                mutable=["cache"],
+            )
+            logp = jax.nn.log_softmax(
+                step_logits[:, 0].astype(jnp.float32), axis=-1
+            )
+            nxt = self._sample(step_logits[:, 0], step_rng)
+            return (
+                (mutated["cache"], nxt, pos + 1),
+                (token, jnp.take_along_axis(
+                    logp, nxt[:, None], axis=-1
+                )[:, 0], nxt),
+            )
+
+        pos0 = jnp.full((b,), prompt_len, jnp.int32)
+        step_rngs = jax.random.split(rng, n_new - 1) if n_new > 1 else (
+            jnp.zeros((0, 2), jnp.uint32)
+        )
+        (_, last_token, _), (fed, logps, sampled) = jax.lax.scan(
+            decode_step, (cache, first, pos0), step_rngs
+        )
+        # Sequence assembly: prompts + first + each scan step's sample.
+        generated = jnp.concatenate(
+            [first[:, None]]
+            + ([jnp.swapaxes(sampled, 0, 1)] if n_new > 1 else []),
+            axis=1,
+        )
+        tokens = jnp.concatenate([prompts, generated], axis=1)
+
+        # Logprob of the FIRST sampled token under the prefill logits;
+        # later tokens' logprobs come out of the scan.
+        logp0 = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        )
+        first_logp = jnp.take_along_axis(
+            logp0, first[:, None], axis=-1
+        )[:, 0]
+        all_logps = jnp.concatenate(
+            [first_logp[:, None]]
+            + ([jnp.swapaxes(logps, 0, 1)] if n_new > 1 else []),
+            axis=1,
+        )
+        return tokens, all_logps
+
+    def generate(
+        self, params, prompts: jax.Array, rng: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        return self._generate(params, prompts, rng)

@@ -1,18 +1,21 @@
-"""PPO-based RLHF trainer: actor / critic / frozen reference, one mesh.
+"""PPO-based RLHF trainer: actor / critic / frozen reference.
 
 Capability ref: ``atorch/atorch/rl/`` (~3.3k LoC:
 ``trainer/ppo_trainer.py`` PPO loop, ``model_engine/model_engine.py``
 multi-model orchestration of actor/critic/ref/reward across devices,
-``replay_buffer/``).
+``replay_buffer/``, ``inference_backend/``).
 
 TPU redesign: the reference shuttles four torch models between GPUs and a
-DeepSpeed hybrid engine; under SPMD all four live as param pytrees on one
-mesh and every phase is a pure jitted function —
+DeepSpeed hybrid engine; under SPMD every phase is a pure jitted
+function and the engine pieces are separate modules —
 
-* rollout: autoregressive sampling from the actor (full re-forward per
-  token; a KV-cache decode path slots in behind the same signature),
+* rollout: the jitted KV-cache decode loop (``rl/generation.py``; a
+  full-reforward sampler remains as the numerics cross-check),
 * scoring: per-token logprobs under actor and frozen reference, values
-  from the critic, task reward from a user ``reward_fn``,
+  from the critic — per-role meshes/shardings via ``rl/engine.py``
+  (``RLHFEngine``) when roles should shard differently,
+* experience: rollouts buffered and minibatched by
+  ``rl/replay_buffer.py``,
 * learning: GAE advantages, clipped PPO surrogate + value clip + entropy
   bonus, with a per-token KL penalty against the reference policy folded
   into the reward (the standard RLHF shaping).
@@ -58,6 +61,19 @@ class PPOConfig:
     value_clip: float = 0.2
     vf_coef: float = 0.5
     entropy_coef: float = 0.01
+    # Rollout backend: the jitted KV-cache decode loop
+    # (rl/generation.py); False falls back to the full-reforward sampler
+    # (useful as a numerics cross-check — same distribution, ~S x the
+    # rollout FLOPs).
+    use_kv_cache: bool = True
+    # Experience minibatching (rl/replay_buffer.py): each step's rollout
+    # flows through the buffer and PPO epochs iterate shuffled
+    # minibatches of this size, clamped to the rollout size (0 =
+    # whole-rollout batches, the pre-r5 behavior).  PPO is on-policy, so
+    # the buffer holds one rollout at a time; ``buffer_capacity`` must
+    # admit the largest rollout batch (add_rollout raises otherwise).
+    minibatch_size: int = 0
+    buffer_capacity: int = 4096
     gamma: float = 1.0
     gae_lambda: float = 0.95
     ppo_epochs: int = 2
@@ -107,6 +123,7 @@ class PPOTrainer:
         reward_fn: Callable[[np.ndarray], np.ndarray],
         config: PPOConfig = PPOConfig(),
         rng: Optional[jax.Array] = None,
+        engine=None,
     ):
         self.config = config
         self.model_config = model_config
@@ -123,6 +140,17 @@ class PPOTrainer:
         self.critic_params = nn.meta.unbox(
             self.critic.init(k2, dummy)["params"]
         )
+        # Optional RLHFEngine (rl/engine.py): per-role meshes/shardings —
+        # params are pinned to each role's placement and the scoring
+        # passes compile against it.
+        self.engine = engine
+        if engine is not None:
+            self.actor_params = engine.place("actor", self.actor_params)
+            self.ref_params = engine.place("ref", self.ref_params)
+            self.critic_params = engine.place("critic", self.critic_params)
+            self._actor_logp = engine.logprob_fn("actor")
+            self._ref_logp = engine.logprob_fn("ref")
+            self._critic_value = engine.value_fn("critic")
         self.tx = optax.chain(
             optax.clip_by_global_norm(1.0),
             optax.adam(config.learning_rate),
@@ -132,6 +160,23 @@ class PPOTrainer:
         )
         self._sample_step = jax.jit(self._sample_one)
         self._update = jax.jit(self._ppo_update)
+        self._gen_backend = None
+        if config.use_kv_cache:
+            from dlrover_tpu.rl.generation import (
+                GenerationBackend,
+                SamplingParams,
+            )
+
+            self._gen_backend = GenerationBackend(
+                model_config,
+                SamplingParams(
+                    temperature=config.temperature,
+                    max_new_tokens=config.rollout_len,
+                ),
+            )
+        from dlrover_tpu.rl.replay_buffer import ReplayBuffer
+
+        self.replay_buffer = ReplayBuffer(capacity=config.buffer_capacity)
 
     # -- rollout --------------------------------------------------------------
 
@@ -147,7 +192,12 @@ class PPOTrainer:
 
     def rollout(self, prompts: np.ndarray) -> Dict[str, np.ndarray]:
         """Sample ``rollout_len`` tokens after each prompt (right-padded
-        static buffer)."""
+        static buffer).
+
+        Default path: the jitted KV-cache decode loop (rl/generation.py
+        — one compiled program, no per-token host dispatch); the
+        full-reforward fallback keeps the cross-check path alive.
+        """
         batch, prompt_len = prompts.shape
         total = prompt_len + self.config.rollout_len
         if total > self.model_config.max_seq_len:
@@ -155,6 +205,14 @@ class PPOTrainer:
                 f"prompt {prompt_len} + rollout {self.config.rollout_len} "
                 f"exceeds max_seq_len {self.model_config.max_seq_len}"
             )
+        if self._gen_backend is not None:
+            self._rng, gen_rng = jax.random.split(self._rng)
+            tokens, _logps = self._gen_backend.generate(
+                self.actor_params, jnp.asarray(prompts), gen_rng
+            )
+            return {
+                "tokens": np.asarray(tokens), "prompt_len": prompt_len
+            }
         tokens = np.zeros((batch, total), np.int32)
         tokens[:, :prompt_len] = prompts
         length = np.full((batch,), prompt_len, np.int32)
@@ -238,17 +296,23 @@ class PPOTrainer:
         tokens = jnp.asarray(roll["tokens"])
         prompt_len = roll["prompt_len"]
 
-        actor_logits, _ = self.actor.apply(
-            {"params": self.actor_params}, tokens
-        )
-        ref_logits, _ = self.actor.apply(
-            {"params": self.ref_params}, tokens
-        )
-        logp = token_logprobs(actor_logits, tokens)
-        ref_logp = token_logprobs(ref_logits, tokens)
-        values = self.critic.apply(
-            {"params": self.critic_params}, tokens
-        )[:, :-1]
+        if self.engine is not None:
+            # Each role's scoring pass runs on its own mesh/sharding.
+            logp = self._actor_logp(self.actor_params, tokens)
+            ref_logp = self._ref_logp(self.ref_params, tokens)
+            values = self._critic_value(self.critic_params, tokens)[:, :-1]
+        else:
+            actor_logits, _ = self.actor.apply(
+                {"params": self.actor_params}, tokens
+            )
+            ref_logits, _ = self.actor.apply(
+                {"params": self.ref_params}, tokens
+            )
+            logp = token_logprobs(actor_logits, tokens)
+            ref_logp = token_logprobs(ref_logits, tokens)
+            values = self.critic.apply(
+                {"params": self.critic_params}, tokens
+            )[:, :-1]
 
         resp_mask = np.zeros(logp.shape, np.float32)
         resp_mask[:, prompt_len - 1:] = 1.0
@@ -288,10 +352,30 @@ class PPOTrainer:
         }
         params = {"actor": self.actor_params, "critic": self.critic_params}
         metrics = {}
-        for _ in range(cfg.ppo_epochs):
-            params, self.opt_state, metrics = self._update(
-                params, self.opt_state, batch
+        if cfg.minibatch_size:
+            # This rollout's experience goes through the replay buffer
+            # (ref ``replay_buffer.py``): PPO epochs iterate shuffled
+            # fixed-shape minibatches of it (on-policy, so the buffer is
+            # cleared per step; capacity only bounds a single rollout).
+            # Clamp to the rollout size — a minibatch larger than the
+            # rollout would otherwise yield ZERO updates silently.
+            mb_size = min(cfg.minibatch_size, len(prompts))
+            self.replay_buffer.clear()
+            self.replay_buffer.add_rollout(
+                {k: np.asarray(v) for k, v in batch.items()}
             )
+            for mb in self.replay_buffer.minibatches(
+                mb_size, epochs=cfg.ppo_epochs
+            ):
+                params, self.opt_state, metrics = self._update(
+                    params, self.opt_state,
+                    {k: jnp.asarray(v) for k, v in mb.items()},
+                )
+        else:
+            for _ in range(cfg.ppo_epochs):
+                params, self.opt_state, metrics = self._update(
+                    params, self.opt_state, batch
+                )
         self.actor_params = params["actor"]
         self.critic_params = params["critic"]
         out = {k: float(v) for k, v in metrics.items()}

@@ -1,0 +1,85 @@
+"""Experience replay buffer for RLHF.
+
+Capability ref: ``atorch/atorch/rl/replay_buffer/replay_buffer.py``
+(bounded sample store + batch iterator between the experience-generation
+and training phases).
+
+TPU-shaped: samples are dicts of fixed-shape numpy arrays (token
+buffers, masks, advantages...), stored row-wise and minibatched by
+stacking — the training step consumes statically-shaped pytrees, so the
+buffer's job is to hold rollouts until enough exist for a PPO epoch and
+to hand out shuffled, shape-stable minibatches.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Bounded FIFO of experience rows with minibatch sampling."""
+
+    def __init__(self, capacity: int = 4096, seed: int = 0):
+        self.capacity = capacity
+        self._rows: deque = deque(maxlen=capacity)
+        self._rng = np.random.default_rng(seed)
+        self._mu = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def add_rollout(self, batch: Dict[str, np.ndarray]):
+        """Split a batched rollout into rows (axis 0) and append them."""
+        sizes = {k: len(v) for k, v in batch.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"ragged rollout batch: {sizes}")
+        n = next(iter(sizes.values()))
+        if n > self.capacity:
+            # The FIFO would silently discard the oldest rows of THIS
+            # rollout — experience that would then never be trained on.
+            raise ValueError(
+                f"rollout of {n} rows exceeds buffer capacity "
+                f"{self.capacity}; raise the capacity"
+            )
+        with self._mu:
+            for i in range(n):
+                self._rows.append(
+                    {k: np.asarray(v[i]) for k, v in batch.items()}
+                )
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        """One shuffled minibatch (with replacement if undersized)."""
+        with self._mu:
+            if not self._rows:
+                raise ValueError("empty replay buffer")
+            replace = len(self._rows) < batch_size
+            idx = self._rng.choice(
+                len(self._rows), size=batch_size, replace=replace
+            )
+            rows = [self._rows[i] for i in idx]
+        return {
+            k: np.stack([r[k] for r in rows]) for k in rows[0]
+        }
+
+    def minibatches(
+        self, batch_size: int, epochs: int = 1
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Full passes over the buffer in shuffled ``batch_size`` chunks
+        (drops the ragged tail to keep shapes static)."""
+        with self._mu:
+            rows: List[Dict] = list(self._rows)
+        for _ in range(epochs):
+            order = self._rng.permutation(len(rows))
+            for lo in range(0, len(rows) - batch_size + 1, batch_size):
+                chunk = [rows[i] for i in order[lo:lo + batch_size]]
+                yield {
+                    k: np.stack([r[k] for r in chunk]) for k in chunk[0]
+                }
+
+    def clear(self):
+        with self._mu:
+            self._rows.clear()

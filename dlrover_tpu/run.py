@@ -31,9 +31,27 @@ from dlrover_tpu.agent.training_agent import (
 def _parse_args(argv: Optional[List[str]] = None):
     parser = argparse.ArgumentParser(prog="dlrover-tpu-run")
     parser.add_argument(
+        "--job-spec", default="",
+        help="declarative ElasticJobSpec file (.toml/.yaml/.json); CLI "
+             "flags explicitly given override the spec (the reference's "
+             "CRD-spec tier, elasticjob_types.go)",
+    )
+    parser.add_argument(
         "--standalone", action="store_true",
         help="run an in-process master (single-host jobs, no control plane)",
     )
+    parser.add_argument(
+        "--master-only", action="store_true",
+        help="run the job master alone (cluster jobs: agents join over "
+             "the network); with --cloud it also creates the TPU VMs",
+    )
+    parser.add_argument(
+        "--cloud", action="store_true",
+        help="actuate TPU VMs via tpu.googleapis.com (master/tpu_api.py); "
+             "requires --master-only and a --job-spec with [accelerator]",
+    )
+    parser.add_argument("--port", type=int, default=0,
+                        help="master port (0 = ephemeral)")
     parser.add_argument("--master", default="", help="master host:port")
     parser.add_argument("--nnodes", default="1",
                         help="N or MIN:MAX elastic range of TPU hosts")
@@ -47,13 +65,52 @@ def _parse_args(argv: Optional[List[str]] = None):
     parser.add_argument("--network-check", action="store_true")
     parser.add_argument("--save-at-breakpoint", action="store_true")
     parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument(
+        "--device-init-timeout", type=float, default=900.0,
+        help="fail/restart a trainer with no first step within this bound "
+             "(a wedged device runtime hangs below Python; 0 disables)",
+    )
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- trainer command")
     args = parser.parse_args(argv)
+    spec = None
+    if args.job_spec:
+        from dlrover_tpu.common.job_spec import load_job_spec
+
+        spec = load_job_spec(args.job_spec)
+        # Precedence: spec < explicitly-given CLI flags.  argparse skips
+        # defaults for attributes already present on the namespace, so
+        # re-parsing over a spec-seeded namespace leaves spec values in
+        # place unless the flag appeared on the command line.
+        ns = argparse.Namespace(
+            nnodes=f"{spec.nodes.min}:{spec.nodes.max}",
+            node_unit=spec.nodes.unit,
+            max_restarts=spec.trainer.max_restarts,
+            monitor_interval=spec.trainer.monitor_interval,
+            heartbeat_interval=spec.trainer.heartbeat_interval,
+            checkpoint_dir=spec.checkpoint.dir,
+            device_init_timeout=spec.trainer.device_init_timeout,
+        )
+        args = parser.parse_args(argv, namespace=ns)
+        # store_true flags cannot be "unset" on the CLI: OR semantics.
+        args.network_check = (
+            args.network_check or spec.trainer.network_check
+        )
+        args.save_at_breakpoint = (
+            args.save_at_breakpoint or spec.checkpoint.save_at_breakpoint
+        )
+    args.spec = spec
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
-    if not args.command:
-        parser.error("no trainer command given (use: ... -- python train.py)")
+    if not args.command and spec is not None:
+        args.command = list(spec.trainer.command)
+    if not args.command and not args.master_only:
+        parser.error(
+            "no trainer command given (use: ... -- python train.py, or "
+            "[trainer].command in the job spec)"
+        )
+    if args.cloud and (not args.master_only or spec is None):
+        parser.error("--cloud requires --master-only and --job-spec")
     return args
 
 
@@ -65,26 +122,121 @@ def _parse_nnodes(spec: str) -> Tuple[int, int]:
     return n, n
 
 
-def _launch_local_master(num_nodes: int, node_unit: int, min_nodes: int = 0):
+def _master_kwargs_from_spec(spec) -> dict:
+    """The [master]+[brain] spec sections as JobMaster kwargs — one
+    place, so standalone and cluster masters cannot silently diverge."""
+    if spec is None:
+        return {}
+    import dataclasses as _dc
+
+    return dict(
+        heartbeat_timeout=spec.master.heartbeat_timeout,
+        hang_threshold=spec.master.hang_threshold,
+        optimize_interval_s=spec.master.optimize_interval_s,
+        rdzv_waiting_timeout=spec.master.rdzv_waiting_timeout,
+        max_relaunches=spec.master.max_relaunches,
+        state_path=spec.master.state_path,
+        brain_overrides=_dc.asdict(spec.brain),
+    )
+
+
+def _launch_local_master(
+    num_nodes: int, node_unit: int, min_nodes: int = 0, spec=None
+):
     """Standalone mode: in-process master (ref
     ``_launch_dlrover_local_master`` ``elastic_run.py:344-351``)."""
     from dlrover_tpu.master.job_master import JobMaster
 
+    master_kwargs = _master_kwargs_from_spec(spec)
     master = JobMaster(
         port=0, num_nodes=num_nodes, node_unit=node_unit,
-        min_nodes=min_nodes,
+        min_nodes=min_nodes, **master_kwargs,
     )
     port = master.start()
     return master, f"localhost:{port}"
 
 
+def build_cluster_master(args, launcher_factory=None):
+    """--master-only wiring: a network-facing JobMaster, optionally with
+    cloud TPU-VM actuation (the reference's operator role: the master IS
+    the job controller; ``elasticjob_controller.go``).
+
+    ``launcher_factory(spec, master_addr)`` is the test seam; production
+    uses ``tpu_api.make_cloud_launcher``.
+    """
+    import socket
+
+    from dlrover_tpu.master.job_master import JobMaster
+    from dlrover_tpu.master.messages import free_port
+
+    spec = args.spec
+    min_nodes, max_nodes = _parse_nnodes(args.nnodes)
+    port = args.port or free_port()
+    launcher = None
+    if args.cloud:
+        host = os.environ.get("DLROVER_TPU_MASTER_HOST") or (
+            socket.gethostbyname(socket.gethostname())
+        )
+        master_addr = f"{host}:{port}"
+        if launcher_factory is None:
+            from dlrover_tpu.master.tpu_api import make_cloud_launcher
+
+            def launcher_factory(spec, master_addr):
+                return make_cloud_launcher(
+                    spec.job_name, master_addr,
+                    accelerator_type=spec.accelerator.type,
+                    runtime_version=spec.accelerator.runtime_version,
+                    preemptible=spec.accelerator.preemptible,
+                    project=spec.accelerator.project,
+                    zone=spec.accelerator.zone,
+                )
+
+        launcher = launcher_factory(spec, master_addr)
+    master_kwargs = _master_kwargs_from_spec(spec)
+    master = JobMaster(
+        port=port, num_nodes=max_nodes, node_unit=args.node_unit,
+        min_nodes=min_nodes, launcher=launcher, **master_kwargs,
+    )
+    return master, launcher
+
+
+def _run_master_only(args) -> int:
+    master, launcher = build_cluster_master(args)
+    port = master.start()
+    logger.info("cluster master on port %d (cloud=%s)", port, args.cloud)
+    if launcher is not None:
+        master.bootstrap_nodes()
+    try:
+        while True:
+            nm = master.node_manager
+            if nm.job_failed:
+                logger.error("job failed: %s", nm.job_failure_reason)
+                return 1
+            statuses = nm.statuses()
+            if statuses and all(s == "succeeded" for s in statuses.values()):
+                logger.info("all nodes succeeded")
+                return 0
+            time.sleep(2.0)
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        master.stop()
+        if launcher is not None and hasattr(launcher, "shutdown"):
+            launcher.shutdown()
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     args = _parse_args(argv)
+    if args.master_only:
+        return _run_master_only(args)
     min_nodes, max_nodes = _parse_nnodes(args.nnodes)
+    if args.spec is not None and args.spec.trainer.env:
+        # The agent hands its own environment to the trainer subprocess.
+        os.environ.update(args.spec.trainer.env)
     local_master = None
     if args.standalone or not args.master:
         local_master, master_addr = _launch_local_master(
-            max_nodes, args.node_unit, min_nodes
+            max_nodes, args.node_unit, min_nodes, spec=args.spec
         )
         logger.info("standalone master at %s", master_addr)
     else:
@@ -99,6 +251,7 @@ def run(argv: Optional[List[str]] = None) -> int:
         network_check=args.network_check,
         save_at_breakpoint=args.save_at_breakpoint,
         checkpoint_dir=args.checkpoint_dir,
+        device_init_timeout=args.device_init_timeout,
     )
     agent = ElasticAgent(
         config, args.command, master_addr, node_id=args.node_id

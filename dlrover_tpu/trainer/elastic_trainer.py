@@ -138,6 +138,13 @@ class ElasticTrainer:
 
             self.numeric_monitor = NumericHealthMonitor()
         self.epoch = 0
+        # Once a NaN/Inf is observed in the step scalars the live state is
+        # poisoned; checkpoints taken after that point would be restored by
+        # the master's RESTART_WORLD remediation and loop the failure.  The
+        # flag resets on construction — the restart restores the last good
+        # checkpoint into a fresh trainer.
+        self._state_poisoned = False
+        self._last_metrics = None
         self.train = train_lib.build_sharded_train(
             self.model, self.optimizer, self.mesh,
             rules if rules is not None else lr.DEFAULT_RULES,
@@ -178,6 +185,7 @@ class ElasticTrainer:
         placed = train_lib.shard_batch(batch, self.train)
         self.state, metrics = self.train.step(self.state, placed)
         self.step += 1
+        self._last_metrics = metrics
         return metrics
 
     def _dispatch(self, hook: str, *args):
@@ -245,6 +253,20 @@ class ElasticTrainer:
         epoch, so its counter restarts at 0).
         """
         cfg = self.config
+        if epochs:
+            # Single-use iterators (generators, list_iterator,
+            # map/zip/filter, ...) are their own iterator and expose
+            # __next__; containers don't.  (`iter(loader) is loader`
+            # would be the textbook probe, but calling iter() consumes a
+            # pass from stateful re-iterable loaders.)  Each is exhausted
+            # after one pass, so the epoch counter would spin to N while
+            # training a single epoch's worth of data.
+            if hasattr(loader, "__next__"):
+                raise ValueError(
+                    f"fit(epochs={epochs}) needs a re-iterable loader, "
+                    "got a one-shot iterator (pass a list, Dataset, or "
+                    "ElasticDataLoader)"
+                )
         t_start = time.monotonic()
         start_step = self.step
         steps_per_epoch = None
@@ -255,8 +277,15 @@ class ElasticTrainer:
         self._dispatch("on_train_begin")
         done = False
         epoch_iterations = max(1, epochs) if epochs else 1
+        passes = 0
         while not done:
+            # A resumed trainer can start at/past the epoch budget — check
+            # BEFORE running a pass, not only after one completes.
+            if epochs and self.epoch >= epoch_iterations:
+                break
+            batches_this_pass = 0
             for batch in loader:
+                batches_this_pass += 1
                 if self.step >= max_steps:
                     done = True
                     break
@@ -276,6 +305,18 @@ class ElasticTrainer:
                     self.save_checkpoint()
             else:
                 # Loader exhausted: an epoch boundary.
+                passes += 1
+                if epochs and passes > 1 and batches_this_pass == 0:
+                    # A drained elastic loader (master-side epoch budget
+                    # exhausted) or an empty per-host shard after a resize
+                    # legitimately yields nothing — count the epoch and
+                    # let the budget terminate, but say so: an exhausted
+                    # iterator mistakenly passed here looks identical.
+                    logger.warning(
+                        "fit epoch pass %d yielded no batches (drained "
+                        "dataset, empty shard, or a non-re-iterable "
+                        "loader)", passes,
+                    )
                 self.epoch += 1
                 self._dispatch("on_epoch_end", self.epoch)
                 if epochs and self.epoch >= epoch_iterations:
@@ -313,6 +354,8 @@ class ElasticTrainer:
                 for a in found:
                     logger.error("numeric anomaly: %s", a.encode())
                 anomalies = tuple(a.encode() for a in found)
+                if any(a.kind == "nan" for a in found):
+                    self._state_poisoned = True
         if self.client is not None:
             self.client.report_step(
                 self.step,
@@ -330,11 +373,60 @@ class ElasticTrainer:
     def save_checkpoint(self):
         if self._ckpt is None:
             return
+        if self._healthy_to_save() is False:
+            logger.error(
+                "skipping checkpoint at step %d: state holds non-finite "
+                "values; waiting for the master's restart remediation",
+                self.step,
+            )
+            return
         from dlrover_tpu.checkpoint import StorageType
 
         self._ckpt.save_checkpoint(self.step, self.state, StorageType.DISK)
         self._last_saved = self.step
         self._dispatch("on_checkpoint", self.step)
+
+    def _healthy_to_save(self) -> bool:
+        """False when the live state is known (or found) non-finite.
+
+        The monitor only samples on report cadence, so a NaN can land
+        between reports; re-check the newest step's loss at save time —
+        cheap (one scalar sync per checkpoint), and it closes the window
+        where a poisoned state would be committed and later restored by
+        the NumericAnomalyOperator's RESTART_WORLD remediation.
+        """
+        if self._state_poisoned:
+            return False
+        if self.numeric_monitor is not None and (
+            self._last_metrics is not None
+        ):
+            # grad_norm too: the loss is computed on the PRE-update params,
+            # so NaN gradients at the newest step poison the state while
+            # its loss still reads finite.
+            loss = float(self._last_metrics["loss"])
+            grad_norm = self._last_metrics.get("grad_norm")
+            grad_norm = (
+                float(grad_norm) if grad_norm is not None else None
+            )
+            if not np.isfinite(loss) or (
+                grad_norm is not None and not np.isfinite(grad_norm)
+            ):
+                self._state_poisoned = True
+                # Ship the anomaly NOW: the skip path waits for the
+                # master's restart remediation, which only fires on a
+                # reported anomaly — a save-time-only detection (report
+                # and checkpoint cadences misaligned) must not silently
+                # block every future checkpoint with no restart coming.
+                found = self.numeric_monitor.check(
+                    self.step, loss, grad_norm
+                )
+                if self.client is not None:
+                    self.client.report_step(
+                        self.step, tokens=0, loss=loss,
+                        anomalies=tuple(a.encode() for a in found),
+                    )
+                return False
+        return True
 
     def close(self, wait: float = 120.0):
         if self._ckpt is not None:

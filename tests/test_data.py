@@ -139,3 +139,17 @@ def test_index_sharding_client_acks_batches():
     assert fake.done == [0]  # first shard fully consumed
     client.report_batch_done(8)  # 16 consumed -> shard 1 done
     assert fake.done == [0, 1]
+
+
+def test_sharding_client_honors_record_indices():
+    """Master-side sample shuffling must survive the production data path
+    (code-review r5: consumers previously expanded range(start, end) and
+    silently dropped the permutation)."""
+    from dlrover_tpu.data.sharding_client import task_sample_indices
+    from dlrover_tpu.master.messages import ShardTask
+
+    shuffled = ShardTask(task_id=1, start=0, end=4,
+                         record_indices=[9, 2, 7, 0])
+    assert list(task_sample_indices(shuffled)) == [9, 2, 7, 0]
+    plain = ShardTask(task_id=2, start=4, end=7)
+    assert list(task_sample_indices(plain)) == [4, 5, 6]

@@ -15,11 +15,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_cli(tmp_path, extra_cli, extra_trainer, timeout=600):
-    env = dict(os.environ)
+def _run_cli(tmp_path, child_env, extra_cli, extra_trainer, timeout=600):
+    env = dict(child_env)
     env.update(
         {
-            "JAX_PLATFORMS": "cpu",
             "DLROVER_TPU_SOCKET_DIR": str(tmp_path / "socks"),
             # Unique per test: the shm arena is named by job tag and outlives
             # processes, so two tests sharing a tag would see each other's
@@ -43,10 +42,10 @@ def _run_cli(tmp_path, extra_cli, extra_trainer, timeout=600):
 
 
 @pytest.mark.slow
-def test_cli_standalone_training(tmp_path):
+def test_cli_standalone_training(tmp_path, cpu_child_env):
     ckpt_dir = str(tmp_path / "ckpt")
     result = _run_cli(
-        tmp_path,
+        tmp_path, cpu_child_env,
         ["--checkpoint-dir", ckpt_dir, "--monitor-interval", "1"],
         [
             "--steps", "8", "--ckpt-every", "4",
@@ -62,12 +61,12 @@ def test_cli_standalone_training(tmp_path):
 
 
 @pytest.mark.slow
-def test_cli_crash_restart_resume(tmp_path):
+def test_cli_crash_restart_resume(tmp_path, cpu_child_env):
     """Trainer crashes at step 6 (after the step-4 checkpoint); the agent
     restarts it in place; it resumes from step 4 and completes."""
     ckpt_dir = str(tmp_path / "ckpt")
     result = _run_cli(
-        tmp_path,
+        tmp_path, cpu_child_env,
         [
             "--checkpoint-dir", ckpt_dir, "--max-restarts", "2",
             "--monitor-interval", "1",

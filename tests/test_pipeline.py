@@ -235,3 +235,111 @@ def test_schedule_accounting_parity_and_interleaving_bounds():
         - sim_1f1b(4, 32)["useful_fraction"]
     )
     assert gap_big_m < gap_small_m
+
+
+def test_circular_interleave_matches_sequential_forward():
+    """pipeline_interleave=2 (circular, interleaved-1F1B-equivalent
+    schedule) computes the SAME function as the plain stack on the same
+    stage-contiguous params (VERDICT r4 #4)."""
+    cfg1 = _tiny(pp=1)
+    cfgv = _tiny(pp=2, micro=4)
+    cfgv = cfgv.__class__(**{**cfgv.__dict__, "pipeline_interleave": 2})
+    m1, mv = TransformerLM(cfg1), TransformerLM(cfgv)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+    p1 = nn.meta.unbox(m1.init(jax.random.PRNGKey(0), tokens)["params"])
+    pv = _reshape_params_for_stages(p1, stages=2)
+    ref = jax.tree.structure(
+        nn.meta.unbox(mv.init(jax.random.PRNGKey(0), tokens)["params"])
+    )
+    assert jax.tree.structure(pv) == ref  # checkpoint layout unchanged
+    logits1, _ = m1.apply({"params": p1}, tokens)
+    logitsv, _ = mv.apply({"params": pv}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits1), np.asarray(logitsv), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_circular_interleave_grads_match_sequential():
+    cfg1 = _tiny(pp=1)
+    cfgv = _tiny(pp=2, micro=2)
+    cfgv = cfgv.__class__(**{**cfgv.__dict__, "pipeline_interleave": 2})
+    m1, mv = TransformerLM(cfg1), TransformerLM(cfgv)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 128)
+    p1 = nn.meta.unbox(m1.init(jax.random.PRNGKey(0), tokens)["params"])
+    pv = _reshape_params_for_stages(p1, stages=2)
+
+    def loss1(p):
+        logits, _ = m1.apply({"params": p}, tokens)
+        return train_lib.cross_entropy_loss(logits, targets)[0]
+
+    def lossv(p):
+        logits, _ = mv.apply({"params": p}, tokens)
+        return train_lib.cross_entropy_loss(logits, targets)[0]
+
+    g1 = _reshape_params_for_stages(jax.grad(loss1)(p1), stages=2)
+    gv = jax.grad(lossv)(pv)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(gv)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_circular_interleave_sharded_train_step():
+    """pp=2 x dp=2 x v=2 over the virtual mesh: the sharded train step
+    runs and first-step loss matches pp=1."""
+    devices = jax.devices()[:4]
+    batch, seq = 8, 16
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 128, size=(batch, seq + 1), dtype=np.int32)
+
+    cfg1 = _tiny(pp=1, remat="full")
+    model1 = TransformerLM(cfg1)
+    mesh1 = build_mesh(ParallelConfig(data=-1), devices=devices[:1])
+    train1 = train_lib.build_sharded_train(
+        model1, train_lib.make_optimizer("sgd", learning_rate=0.0),
+        mesh1, lr.DEFAULT_RULES, global_batch_size=batch, seq_len=seq,
+    )
+    state1 = train1.init(jax.random.PRNGKey(0))
+    params1 = jax.tree.map(np.asarray, state1.params)
+    b1 = train_lib.shard_batch(
+        {"inputs": tokens[:, :-1].copy(), "targets": tokens[:, 1:].copy()},
+        train1,
+    )
+    _, metrics1 = train1.step(state1, b1)
+
+    cfgv = _tiny(pp=2, micro=4, remat="full")
+    cfgv = cfgv.__class__(**{**cfgv.__dict__, "pipeline_interleave": 2})
+    modelv = TransformerLM(cfgv)
+    meshv = build_mesh(ParallelConfig(data=2, pipe=2), devices=devices)
+    trainv = train_lib.build_sharded_train(
+        modelv, train_lib.make_optimizer("sgd", learning_rate=0.0),
+        meshv, lr.DEFAULT_RULES, global_batch_size=batch, seq_len=seq,
+    )
+    statev = trainv.init(jax.random.PRNGKey(0))
+    piped = _reshape_params_for_stages(params1, stages=2)
+    statev = statev.replace(
+        params=jax.tree.map(
+            lambda t, s: jax.device_put(t, s.sharding),
+            piped, statev.params,
+        )
+    )
+    bv = train_lib.shard_batch(
+        {"inputs": tokens[:, :-1].copy(), "targets": tokens[:, 1:].copy()},
+        trainv,
+    )
+    _, metricsv = trainv.step(statev, bv)
+    np.testing.assert_allclose(
+        float(metricsv["loss"]), float(metrics1["loss"]), rtol=2e-3
+    )
+
+
+def test_circular_interleave_validates_config():
+    with pytest.raises(ValueError, match="microbatches >= stages"):
+        _tiny(pp=2, micro=1).__class__(
+            **{**_tiny(pp=2, micro=1).__dict__, "pipeline_interleave": 2}
+        )
+    with pytest.raises(ValueError, match="stages\\*interleave"):
+        _tiny(pp=2, micro=4).__class__(
+            **{**_tiny(pp=2, micro=4).__dict__, "pipeline_interleave": 3}
+        )

@@ -1,0 +1,250 @@
+"""RLHF engine parity (VERDICT r4 missing #4): KV-cache generation
+backend, replay buffer, per-role meshes, PPO e2e with generation in the
+loop on the virtual mesh.
+
+Ref ``atorch/atorch/rl/model_engine/model_engine.py:1-496``,
+``rl/inference_backend/``, ``rl/replay_buffer/``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.transformer import TransformerLM
+from dlrover_tpu.rl.engine import EnginePhase, RLHFEngine, RoleSpec
+from dlrover_tpu.rl.generation import GenerationBackend, SamplingParams
+from dlrover_tpu.rl.ppo import PPOConfig, PPOTrainer
+from dlrover_tpu.rl.replay_buffer import ReplayBuffer
+from dlrover_tpu.runtime.mesh import ParallelConfig
+
+VOCAB, SEQ = 64, 32
+
+
+def _cfg(**kw):
+    return gpt2_config(
+        "124m", num_layers=2, d_model=32, num_heads=2, vocab_size=VOCAB,
+        max_seq_len=SEQ, param_dtype=jnp.float32, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Generation backend: KV-cache decode == full-reforward logits
+# ---------------------------------------------------------------------------
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """The cached decode path must produce the same next-token logits as
+    running the full sequence through the non-decode model."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, VOCAB)
+    params = model.init(rng, tokens)["params"]
+
+    full_logits, _ = model.apply({"params": params}, tokens)
+
+    dcfg = dataclasses.replace(cfg, decode=True)
+    dmodel = TransformerLM(dcfg)
+    # Prefill 8 tokens, then decode 4 one at a time.
+    (pre_logits, _), state = dmodel.apply(
+        {"params": params}, tokens[:, :8],
+        positions=jnp.arange(8)[None, :], mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(pre_logits), np.asarray(full_logits[:, :8]),
+        rtol=2e-4, atol=2e-4,
+    )
+    cache = state["cache"]
+    for i in range(8, 12):
+        (step_logits, _), state = dmodel.apply(
+            {"params": params, "cache": cache}, tokens[:, i:i + 1],
+            positions=jnp.full((2, 1), i), mutable=["cache"],
+        )
+        cache = state["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full_logits[:, i]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_generation_backend_jitted_loop():
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32)
+    )["params"]
+    backend = GenerationBackend(
+        cfg, SamplingParams(max_new_tokens=6, temperature=1.0)
+    )
+    prompts = jax.random.randint(jax.random.PRNGKey(2), (3, 5), 0, VOCAB)
+    tokens, logps = backend.generate(
+        params, prompts, jax.random.PRNGKey(3)
+    )
+    assert tokens.shape == (3, 11)
+    assert logps.shape == (3, 6)
+    np.testing.assert_array_equal(
+        np.asarray(tokens[:, :5]), np.asarray(prompts)
+    )
+    assert np.all(np.asarray(logps) <= 0)
+    # Deterministic under the same key (one jitted program, no host RNG).
+    tokens2, _ = backend.generate(params, prompts, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(tokens), np.asarray(tokens2))
+
+
+def test_generation_backend_greedy_matches_reforward_argmax():
+    """temperature->0 sampling through the cache must follow the argmax
+    of the full-reforward logits (the two rollout paths agree)."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32)
+    )["params"]
+    backend = GenerationBackend(
+        cfg, SamplingParams(max_new_tokens=5, temperature=1e-7)
+    )
+    prompts = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, VOCAB)
+    tokens, _ = backend.generate(params, prompts, jax.random.PRNGKey(3))
+    # Re-derive greedily with the plain model.
+    seq = np.asarray(prompts)
+    for _ in range(5):
+        logits, _ = model.apply({"params": params}, jnp.asarray(seq))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(tokens), seq)
+
+
+# ---------------------------------------------------------------------------
+# Replay buffer
+# ---------------------------------------------------------------------------
+
+
+def test_replay_buffer_rollout_rows_and_minibatches():
+    buf = ReplayBuffer(capacity=16)
+    buf.add_rollout({
+        "tokens": np.arange(12).reshape(6, 2),
+        "adv": np.arange(6.0),
+    })
+    assert len(buf) == 6
+    batches = list(buf.minibatches(batch_size=2, epochs=2))
+    assert len(batches) == 6  # 3 per epoch x 2 epochs
+    for b in batches:
+        assert b["tokens"].shape == (2, 2)
+    # Every row appears exactly once per epoch.
+    seen = sorted(
+        int(b["adv"][i]) for b in batches[:3] for i in range(2)
+    )
+    assert seen == [0, 1, 2, 3, 4, 5]
+    sample = buf.sample(4)
+    assert sample["tokens"].shape == (4, 2)
+    with pytest.raises(ValueError, match="ragged"):
+        buf.add_rollout({"a": np.zeros((2,)), "b": np.zeros((3,))})
+
+
+def test_replay_buffer_rejects_oversized_rollout():
+    """A rollout larger than capacity must fail loudly — the FIFO would
+    otherwise silently drop experience that is then never trained on."""
+    buf = ReplayBuffer(capacity=4)
+    with pytest.raises(ValueError, match="exceeds buffer capacity"):
+        buf.add_rollout({"x": np.arange(6)})
+    buf.add_rollout({"x": np.arange(4)})
+    buf.add_rollout({"x": np.arange(2)})  # across rollouts FIFO still rolls
+    assert len(buf) == 4
+
+
+# ---------------------------------------------------------------------------
+# Engine: per-role meshes + phases
+# ---------------------------------------------------------------------------
+
+
+def test_engine_places_roles_on_distinct_meshes():
+    devices = jax.devices()[:4]
+    cfg = _cfg()
+    roles = {
+        "actor": RoleSpec(
+            parallel=ParallelConfig(data=2, tensor=2), trainable=True
+        ),
+        "ref": RoleSpec(parallel=ParallelConfig(data=4)),
+        "critic": RoleSpec(
+            parallel=ParallelConfig(data=4), trainable=True,
+            kind="critic",
+        ),
+    }
+    engine = RLHFEngine(cfg, roles=roles, devices=devices)
+    assert dict(engine.mesh("actor").shape)["tensor"] == 2
+    assert dict(engine.mesh("ref").shape)["data"] == 4
+
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32)
+    )["params"]
+    placed = engine.place("actor", params)
+    # Tensor-sharded role: some param has a tensor-split sharding.
+    shardings = jax.tree.leaves(
+        jax.tree.map(lambda a: a.sharding.spec, placed)
+    )
+    assert any("tensor" in str(s) for s in shardings)
+    # The frozen ref gets the same values, placed per ITS mesh.
+    ref = engine.sync_roles("actor", "ref")
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(placed)[0]),
+        np.asarray(jax.tree.leaves(ref)[0]),
+    )
+    engine.set_phase(EnginePhase.EXPERIENCE_GENERATION)
+    assert engine.phase == EnginePhase.EXPERIENCE_GENERATION
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, SEQ), 0, VOCAB)
+    logp = engine.logprob_fn("actor")(placed, tokens)
+    ref_logp = engine.logprob_fn("ref")(ref, tokens)
+    # tp=2 vs dp reduce in different float32 orders: same values, looser
+    # tolerance.
+    np.testing.assert_allclose(
+        np.asarray(logp), np.asarray(ref_logp), rtol=3e-3, atol=3e-3
+    )
+
+
+# ---------------------------------------------------------------------------
+# PPO e2e: generation in the loop, engine placement, replay minibatches
+# ---------------------------------------------------------------------------
+
+
+def test_ppo_e2e_with_engine_generation_and_replay():
+    """The whole engine: KV-cache rollouts, per-role meshes (actor
+    tensor-sharded, critic data-parallel), replay minibatching — reward
+    for emitting token 7 must rise."""
+    devices = jax.devices()[:4]
+    cfg = _cfg()
+    roles = {
+        "actor": RoleSpec(
+            parallel=ParallelConfig(data=2, tensor=2), trainable=True
+        ),
+        "ref": RoleSpec(parallel=ParallelConfig(data=4)),
+        "critic": RoleSpec(
+            parallel=ParallelConfig(data=4), trainable=True,
+            kind="critic",
+        ),
+    }
+    engine = RLHFEngine(cfg, roles=roles, devices=devices)
+
+    def reward_fn(tokens):
+        return (tokens[:, -8:] == 7).mean(axis=1).astype(np.float32)
+
+    trainer = PPOTrainer(
+        cfg, reward_fn,
+        PPOConfig(
+            rollout_len=8, learning_rate=5e-3, kl_coef=0.01,
+            ppo_epochs=2, minibatch_size=4, use_kv_cache=True,
+        ),
+        engine=engine,
+    )
+    engine.set_phase(EnginePhase.EXPERIENCE_GENERATION)
+    prompts = np.full((8, 4), 3, np.int32)
+    first = trainer.step(prompts)
+    engine.set_phase(EnginePhase.RL_TRAINING)
+    rewards = [first["mean_task_reward"]]
+    for _ in range(14):
+        rewards.append(trainer.step(prompts)["mean_task_reward"])
+    assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.05, rewards

@@ -143,9 +143,17 @@ def test_table_optimizer_selection_trains():
         keys = np.array([4, 4, 8], np.int64)
         rows, unique, inverse = table.lookup(keys)
         before = table.store.peek(unique)
-        table.apply_gradients(unique, np.ones((unique.size, DIM), np.float32))
+        grads = np.ones((unique.size, DIM), np.float32)
+        extra = {}
+        if optimizer == "adahessian":
+            extra["hessian_rows"] = 0.5 * grads
+        table.apply_gradients(unique, grads, **extra)
         after = table.store.peek(unique)
         assert not np.allclose(before, after), optimizer
+    with pytest.raises(ValueError, match="hessian_rows"):
+        t = EmbeddingTable("t2", DIM, optimizer="adahessian", native=False)
+        _, unique, _ = t.lookup(np.array([1], np.int64))
+        t.apply_gradients(unique, np.ones((1, DIM), np.float32))
 
 
 def test_table_rejects_unknown_optimizer():
@@ -193,3 +201,91 @@ def test_int64_min_key_survives_growth():
     after = store.peek(np.array([key_min], np.int64))
     np.testing.assert_array_equal(after[0], row0[0])
     assert len(store) == 5001
+
+
+def _radam_reference(w0, grads, lr, b1, b2, eps, wd):
+    """RAdam per the paper (Liu et al. 2020), rectifier defined for
+    rho_t > 4; matches tfplus RectifiedAdam group-apply semantics."""
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    rho_inf = 2.0 / (1.0 - b2) - 1.0
+    for t, g in enumerate(grads, start=1):
+        g = g.astype(np.float64)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        if rho_t > 4.0:
+            rect = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                           / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            update = rect * m_hat / (np.sqrt(v / (1 - b2 ** t)) + eps)
+        else:
+            update = m_hat
+        w = w - lr * (update + wd * w)
+    return w.astype(np.float32)
+
+
+def test_radam_matches_paper_reference():
+    keys = np.array([5, 9], np.int64)
+    w0 = np.random.default_rng(4).normal(size=(2, DIM)).astype(np.float32)
+    grads = [
+        np.random.default_rng(i + 20).normal(size=(2, DIM)).astype(
+            np.float32
+        )
+        for i in range(6)  # crosses the rho_t > 4 warmup boundary
+    ]
+    want = _radam_reference(w0, grads, lr=0.1, b1=0.9, b2=0.999,
+                            eps=1e-8, wd=0.01)
+    for store in stores():
+        _seed_store(store, keys, w0)
+        for t, g in enumerate(grads, start=1):
+            store.apply_group_radam(keys, g, lr=0.1, b1=0.9, b2=0.999,
+                                    eps=1e-8, weight_decay=0.01, t=t)
+        np.testing.assert_allclose(store.peek(keys), want, rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_adahessian_scales_by_curvature_not_gradient():
+    """v tracks h^2: with h = 2*g the steps must shrink vs adam-like
+    h = g (the defining property of the curvature-scaled update)."""
+    keys = np.array([1], np.int64)
+    w0 = np.ones((1, DIM), np.float32)
+    g = np.full((1, DIM), 0.5, np.float32)
+    for store in stores():
+        flat = KVStore(DIM, native=store.native)
+        _seed_store(store, keys, w0)
+        _seed_store(flat, keys, w0)
+        store.apply_group_adahessian(keys, g, hessian=2 * g, lr=0.1, t=1)
+        flat.apply_group_adahessian(keys, g, hessian=g, lr=0.1, t=1)
+        step_big_h = np.abs(1.0 - store.peek(keys))
+        step_small_h = np.abs(1.0 - flat.peek(keys))
+        assert np.all(step_big_h < step_small_h)
+
+
+def test_native_python_parity_radam_adahessian():
+    if _load_native() is None:
+        pytest.skip("no native build")
+    keys = np.array([1, 2, 3], np.int64)
+    w0 = np.random.default_rng(8).normal(size=(3, DIM)).astype(np.float32)
+    g = np.random.default_rng(9).normal(size=(3, DIM)).astype(np.float32)
+    h = np.abs(np.random.default_rng(10).normal(size=(3, DIM))).astype(
+        np.float32
+    )
+    for apply_name, kwargs in [
+        ("apply_group_radam", dict(lr=0.1, t=1, weight_decay=0.01)),
+        ("apply_group_radam", dict(lr=0.1, t=50)),  # past the rectifier
+        ("apply_group_adahessian", dict(hessian=h, lr=0.1, t=2)),
+    ]:
+        native = KVStore(DIM, native=True)
+        python = KVStore(DIM, native=False)
+        for s in (native, python):
+            _seed_store(s, keys, w0)
+            getattr(s, apply_name)(keys, g, **kwargs)
+        # NumPy promotes the bias-corrected intermediates to float64; the
+        # C row math stays float32 — small-t bias terms amplify the
+        # rounding gap to ~1e-5.
+        np.testing.assert_allclose(
+            native.peek(keys), python.peek(keys), rtol=2e-5, atol=2e-5,
+            err_msg=f"{apply_name} {kwargs}",
+        )

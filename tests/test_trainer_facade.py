@@ -215,3 +215,203 @@ def test_text_reader_drives_table_shards_end_to_end(tmp_path):
     assert seen == [f"sample {i}" for i in range(40)]
     assert manager.finished()
     reader.close()
+
+
+def test_one_shot_generator_with_epochs_raises():
+    """A generator exhausted after its first pass must not let the epoch
+    counter spin to N while training one epoch of data (ADVICE r4)."""
+    trainer = _tiny_trainer()
+    with pytest.raises(ValueError, match="re-iterable"):
+        trainer.fit(_batches(3), max_steps=100, epochs=3)
+    assert trainer.step == 0  # refused up front, nothing trained
+
+    # A re-iterable loader that drains early terminates cleanly (no crash:
+    # e.g. an elastic loader whose master-side epoch budget exhausted).
+    class DrainOnce:
+        def __init__(self):
+            self.passes = 0
+
+        def __iter__(self):
+            self.passes += 1
+            return iter(list(_batches(2)) if self.passes == 1 else [])
+
+    final = trainer.fit(DrainOnce(), max_steps=100, epochs=3)
+    assert final == 2  # trained what existed, counted epochs through
+
+
+def test_resume_at_epoch_budget_runs_nothing(tmp_path):
+    """A trainer resumed at/past its epoch budget must not run an extra
+    epoch (the epoch check happens before each pass, ADVICE r4)."""
+    data = list(_batches(3))
+    trainer = _tiny_trainer(tmp_path=tmp_path)
+    trainer.fit(data, max_steps=6, epochs=2)
+    trainer.close()
+
+    resumed = _tiny_trainer(tmp_path=tmp_path)
+    assert resumed.step == 6
+    resumed.fit(data, max_steps=100, epochs=2)  # budget already consumed
+    assert resumed.step == 6  # zero additional steps
+    resumed.close()
+
+
+def test_nan_state_never_checkpointed(tmp_path):
+    """Once the step scalars go non-finite the live state is poisoned;
+    checkpoints taken after that would be restored by the master's
+    restart remediation and loop the failure (ADVICE r4)."""
+    import jax.numpy as jnp2
+
+    trainer = _tiny_trainer(tmp_path=tmp_path)
+    trainer.fit(list(_batches(2)), max_steps=2)
+    good_step = trainer._last_saved
+    assert good_step == 2
+
+    # Poison via the save-time finiteness re-check (a NaN landing between
+    # report ticks).
+    trainer.step = 3
+    trainer._last_metrics = {"loss": jnp2.float32(float("nan"))}
+    trainer.save_checkpoint()
+    assert trainer._last_saved == good_step  # skipped
+    assert trainer._state_poisoned
+
+    # The end-of-fit flush goes through the same gate.
+    trainer.save_checkpoint()
+    assert trainer._last_saved == good_step
+    trainer.close()
+
+
+def test_nan_report_poisons_state():
+    """The monitor path: a NaN loss in _report marks the state poisoned."""
+    trainer = _tiny_trainer()
+    trainer.step = 5
+    trainer._report({"loss": float("nan")})
+    assert trainer._state_poisoned
+
+
+def test_table_splitter_subepochs_bound_shard_count():
+    """VERDICT r4 #7: huge datasets split into subepochs so the master
+    never materializes more than max_shard_count shards at once (ref
+    ``dataset_splitter.py:180-196``)."""
+    params = DatasetShardParams(
+        dataset_name="huge", dataset_size=1000, shard_size=10,
+        num_epochs=2, max_shard_count=25,  # 100 shards/epoch > 25
+    )
+    splitter = TableDatasetSplitter(params)
+    assert splitter._subepochs_per_epoch == 4
+    all_ranges = []
+    epochs_seen = []
+    while not splitter.epoch_finished():
+        shards = splitter.create_shards()
+        assert len(shards) <= 25  # the OOM guard
+        epochs_seen.append(shards[0].epoch)
+        all_ranges.extend((s.start, s.end) for s in shards)
+    # 2 user epochs x 4 subepochs each ran; every row covered twice.
+    assert len(all_ranges) == 200
+    covered = sorted(all_ranges)
+    assert covered[0] == (0, 10) and covered[-1] == (990, 1000)
+    assert epochs_seen == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_table_splitter_subepoch_shuffle_stays_in_window():
+    params = DatasetShardParams(
+        dataset_name="huge", dataset_size=100, shard_size=10,
+        num_epochs=1, shuffle=True, max_shard_count=5,
+    )
+    splitter = TableDatasetSplitter(params)
+    first = splitter.create_shards()
+    # Shuffled ORDER, but every shard stays inside subepoch 0's window.
+    assert all(s.end <= 50 for s in first)
+    assert sorted(s.start for s in first) == [0, 10, 20, 30, 40]
+    second = splitter.create_shards()
+    assert all(s.start >= 50 for s in second)
+
+
+def test_text_splitter_shuffle_yields_record_indices():
+    """VERDICT r4 #7: shuffled text shards carry sample-level indices
+    from a whole-epoch permutation (ref ``dataset_splitter.py:300-324``),
+    not just a shuffled shard order."""
+    params = DatasetShardParams(
+        dataset_name="t", dataset_size=20, shard_size=8,
+        num_epochs=2, shuffle=True, storage_type="text",
+    )
+    splitter = make_splitter(params)
+    assert isinstance(splitter, TextDatasetSplitter)
+    shards = splitter.create_shards()
+    assert [len(s.record_indices) for s in shards] == [8, 8, 4]
+    flat = [i for s in shards for i in s.record_indices]
+    assert sorted(flat) == list(range(20))  # a permutation: every line once
+    assert flat != list(range(20))  # and actually shuffled
+    # Epoch 2 uses a different permutation.
+    flat2 = [i for s in splitter.create_shards() for i in s.record_indices]
+    assert sorted(flat2) == list(range(20)) and flat2 != flat
+
+
+def test_text_unshuffled_stays_range_based():
+    params = DatasetShardParams(
+        dataset_name="t", dataset_size=20, shard_size=8,
+        num_epochs=1, storage_type="text",
+    )
+    shards = make_splitter(params).create_shards()
+    assert all(s.record_indices is None for s in shards)
+    assert [(s.start, s.end) for s in shards] == [(0, 8), (8, 16), (16, 20)]
+
+
+def test_record_indices_roundtrip_through_checkpoint():
+    params = DatasetShardParams(
+        dataset_name="t", dataset_size=12, shard_size=5,
+        num_epochs=1, shuffle=True, storage_type="text",
+    )
+    manager = DatasetManager(make_splitter(params))
+    task = manager.get_task(node_id=0)  # one in flight
+    state = manager.checkpoint()
+
+    fresh = DatasetManager(make_splitter(params))
+    fresh.restore(state)
+    restored = []
+    while True:
+        t = fresh.get_task(node_id=1)
+        if t.empty:
+            break
+        restored.append(t)
+        fresh.report_task(t.task_id, success=True)
+    # Pending AND the in-flight shard both came back, indices intact.
+    flat = sorted(i for t in restored for i in t.record_indices)
+    assert flat == list(range(12))
+    assert any(t.record_indices == task.record_indices for t in restored)
+
+
+def test_text_reader_resolves_shuffled_indices(tmp_path):
+    from dlrover_tpu.data.text_shards import TextShardReader
+
+    path = tmp_path / "d.txt"
+    path.write_text("".join(f"line {i}\n" for i in range(15)))
+    reader = TextShardReader(str(path))
+    params = DatasetShardParams(
+        dataset_name="d", dataset_size=15, shard_size=6,
+        num_epochs=1, shuffle=True, storage_type="text",
+    )
+    seen = []
+    for shard in make_splitter(params).create_shards():
+        lines = reader.read_task(shard)
+        assert lines == [f"line {i}" for i in shard.record_indices]
+        seen.extend(lines)
+    assert sorted(seen) == sorted(f"line {i}" for i in range(15))
+    reader.close()
+
+
+def test_text_shuffle_bounded_by_subepoch_window():
+    """The text splitter's permutation (and so shard-checkpoint size) is
+    bounded by the max_shard_count window, like the table splitter."""
+    params = DatasetShardParams(
+        dataset_name="huge-text", dataset_size=100, shard_size=10,
+        num_epochs=1, shuffle=True, storage_type="text",
+        max_shard_count=5,  # 10 shards/epoch > 5 -> 2 subepochs
+    )
+    splitter = make_splitter(params)
+    first = splitter.create_shards()
+    assert len(first) == 5
+    flat = [i for s in first for i in s.record_indices]
+    assert sorted(flat) == list(range(50))  # only window 0's lines
+    second = splitter.create_shards()
+    flat2 = [i for s in second for i in s.record_indices]
+    assert sorted(flat2) == list(range(50, 100))
+    assert splitter.epoch_finished()

@@ -125,7 +125,9 @@ def simulate(S: int, M: int, v: int = 2) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def measure(S: int, M: int, layers: int, steps: int = 3) -> tuple:
+def measure(
+    S: int, M: int, layers: int, steps: int = 3, v: int = 1
+) -> tuple:
     """-> (step seconds, tokens/second) on the current mesh."""
     import jax
     import numpy as np
@@ -141,6 +143,7 @@ def measure(S: int, M: int, layers: int, steps: int = 3) -> tuple:
         "124m", num_layers=layers, d_model=128, num_heads=4,
         vocab_size=512, max_seq_len=128,
         pipeline_stages=S, num_microbatches=M if S > 1 else 0,
+        pipeline_interleave=v,
     )
     # Hold the PER-MICROBATCH shape constant across M (4 rows per
     # microbatch x the data axis): otherwise shrinking microbatches mix
@@ -203,11 +206,25 @@ def main():
                 # overhead, and the bubble model predicts its shape in M.
                 predicted = (M + S - 1) / M
                 rows.append({
-                    "S": S, "M": M, "step_s": round(t, 4),
+                    "S": S, "M": M, "v": 1, "step_s": round(t, 4),
                     "tokens_per_s": round(tps, 0),
                     "pipe1_over_pipeS_throughput": round(base_tps / tps, 3),
                     "model_bubble_factor": round(predicted, 3),
                 })
+                # Circular (interleaved-1F1B-equivalent) schedule at the
+                # same operating point, when the layer count allows v=2.
+                if M >= S and args.layers % (S * 2) == 0:
+                    tv, tpsv = measure(S, M, args.layers, v=2)
+                    rows.append({
+                        "S": S, "M": M, "v": 2, "step_s": round(tv, 4),
+                        "tokens_per_s": round(tpsv, 0),
+                        "pipe1_over_pipeS_throughput": round(
+                            base_tps / tpsv, 3
+                        ),
+                        "model_bubble_factor": round(
+                            (2 * M + S - 1) / (2 * M), 3
+                        ),
+                    })
         out["measured"] = {
             "pipe1_step_s": round(base_s, 4),
             "pipe1_tokens_per_s": round(base_tps, 0),

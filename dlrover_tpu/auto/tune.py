@@ -94,6 +94,7 @@ class Candidate:
     ce_chunks: int = 0                       # 0 = unchunked CE
     microbatches: int = 0                    # 0 = pipe default
     quantized_dcn: bool = False              # int8 DCN collectives
+    interleave: int = 0                      # 0/1 = plain; v>=2 circular
     est_step_time: float = math.inf
     est_hbm_gb: float = math.inf
     measured_step_time: Optional[float] = None
@@ -115,6 +116,8 @@ class Candidate:
             extras += f" ce={self.ce_chunks}"
         if self.microbatches:
             extras += f" mb={self.microbatches}"
+        if self.interleave > 1:
+            extras += f" il={self.interleave}"
         if self.quantized_dcn:
             extras += " q8dcn"
         return f"[{live or 'dp=1'} remat={self.remat}{batch}{extras}]"
@@ -172,8 +175,14 @@ def _knob_space(
     ce_options = [0, 16] if search_kernels else [0]
     if pipe > 1:
         micro = [pipe, 2 * pipe, 4 * pipe]
+        # Circular interleave (parallel/pipeline.py _circular): v=2 cuts
+        # the bubble fraction to (S-1)/(2M+S-1) at 2x handoff + weight
+        # streaming; only legal when the chunks divide the layers.  Every
+        # micro option already satisfies the M >= S wrap constraint.
+        il = [0] + ([2] if config.num_layers % (pipe * 2) == 0 else [])
     else:
         micro = [0]
+        il = [0]
     # The DCN knob is a kernel-level transport choice like flash blocks /
     # CE chunking: gate it on the same opt-in so estimate-only runs with
     # search_kernels=False never have their mesh ranking skewed by an
@@ -181,8 +190,9 @@ def _knob_space(
     dcn = [False, True] if (search_kernels and multihost) else [False]
     return [
         {"flash_block": fb, "ce_chunks": ce, "microbatches": mb,
-         "quantized_dcn": q}
+         "quantized_dcn": q, "interleave": v}
         for fb in blocks for ce in ce_options for mb in micro for q in dcn
+        for v in il
     ]
 
 
@@ -373,7 +383,16 @@ def _estimate(
         micro = max(
             cand.microbatches or config.num_microbatches or p.pipe, p.pipe
         )
-        bubble = 1 + (p.pipe - 1) / micro
+        v = max(cand.interleave, 1)
+        # Circular interleave divides the bubble by v; the price is v x
+        # weight streaming (each chunk's params re-read every lap) and
+        # the per-step relayout all-to-all, folded in as extra HBM/ICI
+        # time on the param bytes.
+        bubble = 1 + (p.pipe - 1) / (v * micro)
+        if v > 1:
+            # param_b is already per-device bytes: no second /shard.
+            t_hbm += (v - 1) * (param_b * 3) / hbm_bw
+            t_ici += param_b / ici_bw
         rows_per_micro = tokens / seq_len / max(p.data * p.fsdp, 1) / micro
         if rows_per_micro < 1:
             cand.rejected = f"microbatches {micro} > local batch rows"
@@ -402,6 +421,7 @@ def _measure(
             (cand.microbatches or cand.parallel.pipe)
             if cand.parallel.pipe > 1 else 0
         ),
+        pipeline_interleave=max(cand.interleave, 1),
     )
     if cand.flash_block != (0, 0):
         overrides["flash_block_q"] = cand.flash_block[0]
@@ -445,7 +465,7 @@ def _cand_key(c: Candidate):
     return (
         p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor, c.remat,
         c.global_batch_size, c.flash_block, c.ce_chunks, c.microbatches,
-        c.quantized_dcn,
+        c.quantized_dcn, c.interleave,
     )
 
 
@@ -501,7 +521,7 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
          _REMAT_CODES[best.remat], best.global_batch_size,
          best.flash_block[0], best.flash_block[1], best.ce_chunks,
-         best.microbatches, int(best.quantized_dcn)],
+         best.microbatches, int(best.quantized_dcn), best.interleave],
         np.int64,
     )
     agreed = multihost_utils.broadcast_one_to_all(key)
@@ -524,6 +544,7 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         ce_chunks=int(agreed[10]),
         microbatches=int(agreed[11]),
         quantized_dcn=bool(agreed[12]),
+        interleave=int(agreed[13]),
     )
     for cand in ranked:
         if (
@@ -735,6 +756,7 @@ def auto_tune(
             (best.microbatches or best.parallel.pipe)
             if best.parallel.pipe > 1 else 0
         ),
+        pipeline_interleave=max(best.interleave, 1),
     )
     if best.flash_block != (0, 0):
         cfg_overrides["flash_block_q"] = best.flash_block[0]

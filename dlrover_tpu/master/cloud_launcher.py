@@ -273,7 +273,27 @@ class CloudNodeLauncher(NodeLauncher):
                         "retired node %d", node_id,
                     )
                     continue
-            self._create_with_retry(node_id)
+            # The creator thread must survive ANYTHING: an escaped
+            # exception here would silently kill the daemon and wedge
+            # every future launch on an undrained queue.
+            try:
+                self._create_with_retry(node_id)
+            except CloudError as e:
+                # Transient API failure outside the per-call handling
+                # (e.g. a get_node blip): re-enqueue after backoff.
+                logger.warning(
+                    "cloud launcher: transient API failure for node %d "
+                    "(%s); requeueing", node_id, e,
+                )
+                if not self._stop.wait(self.RETRY_BACKOFF_S):
+                    self._queue.put(node_id)
+            except Exception as e:  # noqa: BLE001
+                logger.error(
+                    "cloud launcher: unexpected error creating node %d: "
+                    "%s", node_id, e,
+                )
+                if self.node_failed_hook is not None:
+                    self.node_failed_hook(node_id, str(e))
 
     def _create_with_retry(self, node_id: int):
         name = self.instance_name(node_id)

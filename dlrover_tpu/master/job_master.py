@@ -163,6 +163,41 @@ class JobMaster:
                 logger.warning("master control loop error: %s", e)
             self._stop.wait(self.CONTROL_LOOP_INTERVAL)
 
+    def job_phase(self) -> str:
+        """Operator-style job lifecycle phase (ref the ElasticJob CRD's
+        status.phase, ``elasticjob_controller.go``): pending -> running ->
+        succeeded | failed."""
+        from dlrover_tpu.master.node_manager import NodeStatus
+
+        nm = self.node_manager
+        if nm.job_failed:
+            return "failed"
+        statuses = nm.statuses()
+        if not statuses:
+            return "pending"
+        values = set(statuses.values())
+        if values == {NodeStatus.SUCCEEDED.value}:
+            return "succeeded"
+        if NodeStatus.RUNNING.value in values or (
+            NodeStatus.SUCCEEDED.value in values
+        ):
+            return "running"
+        return "pending"
+
+    def teardown_nodes(self):
+        """Delete every node's VM through the launcher (the operator's
+        job-teardown half: a finished cloud job must not leave billing
+        VMs behind)."""
+        if self._launcher is None:
+            return
+        for node_id in sorted(self.node_manager.statuses()):
+            try:
+                self._launcher.delete(node_id)
+            except Exception as e:  # noqa: BLE001 - best-effort teardown
+                logger.warning(
+                    "teardown of node %d failed: %s", node_id, e
+                )
+
     def _handle_launch_failed(self, node_id: int, reason: str):
         """The launcher exhausted its create retries: count it against the
         node's relaunch budget (repeated stockouts eventually fail the job

@@ -185,10 +185,17 @@ class TpuVmHttpClient(TpuVmClient):
         if query:
             url += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
+        try:
+            token = self._access_token()
+        except (urllib.error.URLError, OSError, KeyError, ValueError) as e:
+            # The TpuVmClient contract is CloudError on ANY API failure —
+            # a raw metadata-server exception would kill the launcher's
+            # creator thread instead of being retried.
+            raise CloudError(f"UNAUTHENTICATED: token fetch failed: {e}")
         req = urllib.request.Request(
             url, data=data, method=method,
             headers={
-                "Authorization": f"Bearer {self._access_token()}",
+                "Authorization": f"Bearer {token}",
                 "Content-Type": "application/json",
             },
         )

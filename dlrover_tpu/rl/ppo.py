@@ -120,13 +120,35 @@ class PPOTrainer:
     def __init__(
         self,
         model_config: TransformerConfig,
-        reward_fn: Callable[[np.ndarray], np.ndarray],
+        reward_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         config: PPOConfig = PPOConfig(),
         rng: Optional[jax.Array] = None,
         engine=None,
     ):
         self.config = config
         self.model_config = model_config
+        if reward_fn is None:
+            # A learned reward MODEL (ref ``atorch/rl`` reward/cost model
+            # keys): the engine's "reward" role (critic-shaped scalar
+            # head) scores the full sequence; its last-token value is the
+            # task reward.  Place its trained params via
+            # ``engine.place("reward", params)`` before stepping.
+            if engine is None or "reward" not in engine.roles:
+                raise ValueError(
+                    "reward_fn=None needs an engine with a 'reward' role"
+                )
+            rm_value = engine.value_fn("reward")
+
+            def reward_fn(tokens_np: np.ndarray) -> np.ndarray:
+                params = engine.params("reward")
+                if params is None:
+                    raise ValueError(
+                        "place the reward model's params first: "
+                        "engine.place('reward', params)"
+                    )
+                vals = rm_value(params, jnp.asarray(tokens_np))
+                return np.asarray(vals[:, -1], np.float32)
+
         self.reward_fn = reward_fn
         self.actor = TransformerLM(model_config)
         self.critic = CriticModel(model_config)

@@ -208,18 +208,23 @@ def _run_master_only(args) -> int:
         master.bootstrap_nodes()
     try:
         while True:
-            nm = master.node_manager
-            if nm.job_failed:
-                logger.error("job failed: %s", nm.job_failure_reason)
+            phase = master.job_phase()
+            if phase == "failed":
+                logger.error(
+                    "job failed: %s", master.node_manager.job_failure_reason
+                )
                 return 1
-            statuses = nm.statuses()
-            if statuses and all(s == "succeeded" for s in statuses.values()):
-                logger.info("all nodes succeeded")
+            if phase == "succeeded":
+                logger.info("job succeeded")
                 return 0
             time.sleep(2.0)
     except KeyboardInterrupt:
         return 130
     finally:
+        if launcher is not None:
+            # Operator teardown: a finished cloud job must not leave
+            # billing VMs behind.
+            master.teardown_nodes()
         master.stop()
         if launcher is not None and hasattr(launcher, "shutdown"):
             launcher.shutdown()

@@ -22,6 +22,25 @@ from dlrover_tpu.agent.training_agent import (
 )
 
 
+# Device-relay sitecustomize triggers: when present, a PJRT plugin
+# registers at child-interpreter start and dials the relay — a wedged
+# relay then stalls every subprocess ~60 s at ``import jax``.  Tools and
+# tests that want CPU-only children scrub these through ONE list so a
+# newly added trigger cannot be fixed in one place and missed in another.
+DEVICE_RELAY_TRIGGERS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+)
+
+
+def scrub_device_relay_triggers(env: dict) -> dict:
+    """Drop the relay triggers from ``env`` (in place; returned for
+    chaining)."""
+    for trigger in DEVICE_RELAY_TRIGGERS:
+        env.pop(trigger, None)
+    return env
+
+
 def under_agent() -> bool:
     return ENV_COORDINATOR in os.environ
 

@@ -36,11 +36,11 @@ def _cpu_child_env(base=None):
     at ``import jax`` (VERDICT r4 weak #3).  Dropping the trigger makes
     the sitecustomize a no-op, so children boot CPU-clean in ~2 s.
     """
+    from dlrover_tpu.runtime.env import scrub_device_relay_triggers
+
     env = dict(os.environ if base is None else base)
     env["JAX_PLATFORMS"] = "cpu"
-    for trigger in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
-        env.pop(trigger, None)
-    return env
+    return scrub_device_relay_triggers(env)
 
 
 @pytest.fixture

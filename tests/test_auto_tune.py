@@ -166,3 +166,42 @@ def test_unchunked_ce_memory_includes_logits():
     for cand in (plain, chunked):
         tune._estimate(cand, cfg, 64, 512, "adamw", 8)
     assert plain.est_hbm_gb > chunked.est_hbm_gb
+
+
+def test_interleave_knob_enumerated_and_materialized():
+    """pipeline_interleave joins the searched knobs (r5: the circular
+    schedule is a real capability, so auto_tune must be able to pick
+    it); the winner's v lands on the tuned model config."""
+    config = gpt2_config(
+        "124m", num_layers=4, d_model=64, num_heads=4, vocab_size=256,
+        max_seq_len=64,
+    )
+    cands = enumerate_candidates(
+        config, 4, search_kernels=True, seq_len=64,
+    )
+    piped = [c for c in cands if c.parallel.pipe == 2]
+    assert any(c.interleave == 2 for c in piped)
+    assert any(c.interleave == 0 for c in piped)
+    # layers=6 cannot split into 2*2 chunks with pipe=2? 6 % 4 != 0 ->
+    # no v=2 candidates for that pipe depth.
+    config6 = gpt2_config(
+        "124m", num_layers=6, d_model=64, num_heads=4, vocab_size=256,
+        max_seq_len=64,
+    )
+    cands6 = enumerate_candidates(config6, 4, search_kernels=True,
+                                  seq_len=64)
+    assert not any(
+        c.interleave == 2 for c in cands6 if c.parallel.pipe == 2
+    )
+
+    import dataclasses
+
+    from dlrover_tpu.auto.tune import _estimate
+
+    a = next(c for c in piped if c.interleave == 0 and c.microbatches == 2
+             and c.remat == "full" and c.ce_chunks == 0
+             and c.flash_block == (0, 0))
+    b = dataclasses.replace(a, interleave=2)
+    for c in (a, b):
+        _estimate(c, config, 8, 64, "adamw", 4)
+    assert b.est_step_time != a.est_step_time  # the knob changes the model

@@ -265,3 +265,54 @@ stale_after_s = 123.0
     finally:
         master.stop()
         launcher.shutdown()
+
+
+def test_job_phase_lifecycle_and_teardown(tmp_path):
+    """Operator lifecycle (ref elasticjob_controller.go status.phase):
+    pending -> running -> succeeded, and teardown deletes the VMs."""
+    from dlrover_tpu.master.cloud_launcher import (
+        CloudNodeLauncher,
+        FakeTpuVmClient,
+    )
+    from dlrover_tpu.master.job_master import JobMaster
+
+    client = FakeTpuVmClient()
+    launcher = CloudNodeLauncher(client, job_name="ph")
+    master = JobMaster(num_nodes=2, launcher=launcher,
+                       heartbeat_timeout=3600.0)
+    try:
+        assert master.job_phase() == "pending"
+        master.bootstrap_nodes()
+        assert master.job_phase() == "pending"  # VMs up, no heartbeats
+        master.node_manager.report_event(0, "started")
+        assert master.job_phase() == "running"
+        master.node_manager.report_event(1, "started")
+        master.node_manager.report_event(0, "succeeded")
+        assert master.job_phase() == "running"  # one node still going
+        master.node_manager.report_event(1, "succeeded")
+        assert master.job_phase() == "succeeded"
+
+        import time as _t
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and len(client.instances) < 2:
+            _t.sleep(0.05)
+        master.teardown_nodes()
+        assert all(
+            i["state"] == "TERMINATED" for i in client.instances.values()
+        )
+    finally:
+        master.stop()
+        launcher.shutdown()
+
+
+def test_job_phase_failed():
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(num_nodes=1, max_relaunches=0,
+                       heartbeat_timeout=3600.0)
+    try:
+        master.node_manager.report_event(0, "started")
+        master.node_manager.report_event(0, "failed", "boom")
+        assert master.job_phase() == "failed"
+    finally:
+        master.stop()

@@ -248,3 +248,40 @@ def test_ppo_e2e_with_engine_generation_and_replay():
     for _ in range(14):
         rewards.append(trainer.step(prompts)["mean_task_reward"])
     assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.05, rewards
+
+
+def test_reward_model_role_replaces_reward_fn():
+    """reward_fn=None: the engine's 'reward' role (a learned reward
+    model) scores rollouts — the reference's reward-model key
+    (``atorch/rl`` model_keys) rather than a hand-written fn."""
+    from dlrover_tpu.rl.ppo import CriticModel
+
+    devices = jax.devices()[:2]
+    cfg = _cfg()
+    roles = {
+        "actor": RoleSpec(parallel=ParallelConfig(data=2), trainable=True),
+        "ref": RoleSpec(parallel=ParallelConfig(data=2)),
+        "critic": RoleSpec(parallel=ParallelConfig(data=2), trainable=True,
+                           kind="critic"),
+        "reward": RoleSpec(parallel=ParallelConfig(data=2), kind="critic"),
+    }
+    engine = RLHFEngine(cfg, roles=roles, devices=devices)
+    rm_params = CriticModel(cfg).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, SEQ), jnp.int32)
+    )["params"]
+    engine.place("reward", rm_params)
+
+    trainer = PPOTrainer(
+        cfg, reward_fn=None,
+        config=PPOConfig(rollout_len=4, ppo_epochs=1),
+        engine=engine,
+    )
+    prompts = np.full((2, 4), 3, np.int32)
+    metrics = trainer.step(prompts)
+    assert np.isfinite(metrics["loss"])
+    assert np.isfinite(metrics["mean_task_reward"])
+
+    # Without an engine reward role, reward_fn=None must fail loudly.
+    with pytest.raises(ValueError, match="reward"):
+        PPOTrainer(cfg, reward_fn=None,
+                   config=PPOConfig(rollout_len=4))

@@ -40,6 +40,10 @@ class NodeSpec:
     min: int = 1
     max: int = 1
     unit: int = 1  # world size multiple (slice granularity)
+    # Auxiliary typed pool (ref the PS/worker typed replica specs):
+    # data-preprocessing coworker hosts supervised/repaired beside the
+    # trainers but outside the rendezvous and the auto-scaler.
+    coworkers: int = 0
 
 
 @dataclasses.dataclass

@@ -109,13 +109,42 @@ class CoworkerDataLoader:
         source=None,
         slots: int = 0,
         slot_bytes: int = 64 << 20,
+        start_method: str = "auto",
+        stall_timeout_s: float = 300.0,
     ):
+        """``start_method``: "auto" uses the fork-safe "spawn" when
+        ``sample_fn`` pickles and falls back to "fork" (with a warning)
+        for closures — forking a thread-heavy trainer (jax runtime, gRPC
+        servers) can deadlock the child on a lock some other thread held
+        at fork time.  NOTE spawn re-imports the consumer's main module:
+        scripts must build the loader under ``if __name__ ==
+        "__main__"`` (multiprocessing raises its standard bootstrapping
+        error otherwise); pass ``start_method="fork"`` to restore the
+        pre-r5 Linux behavior.  ``stall_timeout_s``: raise instead of
+        hanging forever when live-but-stuck workers produce nothing (0
+        disables)."""
         self.sample_fn = sample_fn
         self.batch_size = batch_size
         self.num_workers = max(1, num_workers)
         self.source = source
         self.num_slots = slots or 2 * self.num_workers
         self.slot_bytes = slot_bytes
+        self.stall_timeout_s = stall_timeout_s
+        if start_method == "auto":
+            import pickle
+
+            try:
+                pickle.dumps(sample_fn)
+                start_method = "spawn"
+            except Exception:  # noqa: BLE001 - any pickle failure
+                logger.warning(
+                    "coworker sample_fn is not picklable; falling back "
+                    "to fork workers (closures inherit, but forking a "
+                    "multithreaded trainer risks child deadlock — prefer "
+                    "a picklable callable class)"
+                )
+                start_method = "fork"
+        self.start_method = start_method
         self._shms: List[shared_memory.SharedMemory] = []
         self._procs: List[mp.Process] = []
         self._started = False
@@ -131,7 +160,7 @@ class CoworkerDataLoader:
             yield from self.source
 
     def _start(self):
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context(self.start_method)
         # Bounded: with an endless index source the feeder must block once
         # the pipeline is full instead of buffering tasks forever.
         self._task_queue = ctx.Queue(maxsize=self.num_slots)
@@ -199,6 +228,9 @@ class CoworkerDataLoader:
         feeder.start()
         next_seq = 0
         held: Dict[int, Tuple[int, Dict]] = {}
+        import time as _time
+
+        last_progress = _time.monotonic()
         try:
             while True:
                 if (
@@ -209,6 +241,7 @@ class CoworkerDataLoader:
                     return
                 try:
                     seq, slot, meta = self._ready_queue.get(timeout=0.5)
+                    last_progress = _time.monotonic()
                 except _queue.Empty:
                     # Any abnormal worker exit is fatal: its in-flight seq
                     # is lost and in-order delivery would stall forever.
@@ -220,6 +253,21 @@ class CoworkerDataLoader:
                         raise RuntimeError(
                             f"coworker processes died (exit codes {dead})"
                         ) from None
+                    if self.stall_timeout_s and (
+                        _time.monotonic() - last_progress
+                        > self.stall_timeout_s
+                    ):
+                        # Workers ALIVE but producing nothing: the
+                        # live-but-wedged signature (e.g. a forked child
+                        # deadlocked on an inherited lock).  Crash loudly
+                        # — the agent restarts a crashed trainer; nothing
+                        # rescues a silently hung one.
+                        raise RuntimeError(
+                            "coworker pipeline stalled: no batch for "
+                            f"{self.stall_timeout_s:.0f}s with "
+                            f"{sum(p.is_alive() for p in self._procs)} "
+                            "live workers (deadlocked child?)"
+                        )
                     continue
                 if slot == -1:
                     raise RuntimeError(

@@ -216,16 +216,27 @@ def _collate(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     }
 
 
+class _SyntheticLMSample:
+    """Picklable synthetic-LM sample callable: a class instance, not a
+    closure, so coworker workers can start via the fork-safe "spawn"
+    method (closures force fork, and forking a thread-heavy trainer can
+    deadlock the child on an inherited lock)."""
+
+    def __init__(self, vocab_size: int, seq_len: int, seed: int = 0):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.seed = seed
+
+    def __call__(self, index: int) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(self.seed * 1_000_003 + index)
+        tokens = rng.integers(
+            0, self.vocab_size, size=(self.seq_len + 1,), dtype=np.int32
+        )
+        return {"inputs": tokens[:-1], "targets": tokens[1:]}
+
+
 def synthetic_lm_sample_fn(
     vocab_size: int, seq_len: int, seed: int = 0
 ) -> Callable[[int], Dict[str, np.ndarray]]:
     """Deterministic synthetic LM data (bench + tests)."""
-
-    def sample(index: int) -> Dict[str, np.ndarray]:
-        rng = np.random.default_rng(seed * 1_000_003 + index)
-        tokens = rng.integers(
-            0, vocab_size, size=(seq_len + 1,), dtype=np.int32
-        )
-        return {"inputs": tokens[:-1], "targets": tokens[1:]}
-
-    return sample
+    return _SyntheticLMSample(vocab_size, seq_len, seed)

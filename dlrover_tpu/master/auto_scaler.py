@@ -103,7 +103,7 @@ class JobAutoScaler:
 
     def decide(self) -> ScalePlan:
         """Compare live inventory with the target; no side effects."""
-        statuses = self.node_manager.statuses()
+        statuses = self.node_manager.statuses(pool="worker")
         live = [
             n for n, s in statuses.items()
             if s in (NodeStatus.RUNNING.value, NodeStatus.PENDING.value)
@@ -134,7 +134,7 @@ class JobAutoScaler:
         if self.optimizer is None:
             return
         now = time.monotonic()
-        statuses = self.node_manager.statuses()
+        statuses = self.node_manager.statuses(pool="worker")
         live = sum(
             1 for s in statuses.values() if s == NodeStatus.RUNNING.value
         )

@@ -50,6 +50,7 @@ class JobMaster:
         optimize_interval_s: float = 300.0,
         state_path: str = "",
         brain_overrides: Optional[Dict[str, float]] = None,
+        pools: Optional[Dict[str, int]] = None,
     ):
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager()
@@ -62,6 +63,7 @@ class JobMaster:
             launcher=launcher,
             max_relaunches=max_relaunches,
             heartbeat_timeout=heartbeat_timeout,
+            pools=pools,
         )
         from dlrover_tpu.master.brain import RunningJobOptimizer
 
@@ -172,7 +174,9 @@ class JobMaster:
         nm = self.node_manager
         if nm.job_failed:
             return "failed"
-        statuses = nm.statuses()
+        # The WORKER pool decides the phase: auxiliary pools (coworker
+        # preprocessing hosts) serve the workers and never "succeed".
+        statuses = nm.statuses(pool="worker")
         if not statuses:
             return "pending"
         values = set(statuses.values())
@@ -309,8 +313,12 @@ class JobMaster:
         self.servicer.sync_service.remove_node(node_id)
         self.task_manager.recover_tasks(node_id)
         self.speed_monitor.reset_running_speed()
-        if self.auto_scaler is None:
-            # No scaler repair loop: relaunch directly (budget-limited).
+        if self.auto_scaler is None or (
+            self.node_manager.pool_of(node_id) != "worker"
+        ):
+            # No scaler repair loop — or an auxiliary-pool node, which
+            # the scaler (worker-pool-scoped by design) never repairs:
+            # relaunch directly (budget-limited).
             self.node_manager.launch_node(node_id)
 
     def _handle_node_retired(self, node_id: int):

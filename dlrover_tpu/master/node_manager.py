@@ -37,8 +37,10 @@ class ExceptionLevel(str, Enum):
 
 
 class NodeState:
-    def __init__(self, node_id: int, max_relaunches: int = 3):
+    def __init__(self, node_id: int, max_relaunches: int = 3,
+                 node_type: str = "worker"):
         self.node_id = node_id
+        self.node_type = node_type
         self.status = NodeStatus.PENDING
         self.last_heartbeat = time.time()
         self.relaunch_count = 0
@@ -117,6 +119,11 @@ class LocalNodeLauncher(NodeLauncher):
 
 class NodeManager:
     HEARTBEAT_TIMEOUT = 300.0
+    # Node-id namespace per typed pool (ref typed PS/worker managers,
+    # ``master/node/ps.py:369`` / ``worker.py:307``): the "worker" pool
+    # owns [0, POOL_ID_STRIDE); each additional pool the next stride.
+    # Agents carry plain node ids, so the wire protocol is unchanged.
+    POOL_ID_STRIDE = 10_000
 
     def __init__(
         self,
@@ -124,19 +131,53 @@ class NodeManager:
         launcher: Optional[NodeLauncher] = None,
         max_relaunches: int = 3,
         heartbeat_timeout: float = 0.0,
+        pools: Optional[Dict[str, int]] = None,
     ):
+        """``pools`` maps extra typed pools to their sizes (e.g.
+        ``{"coworker": 2}`` — data-preprocessing hosts beside the
+        ``num_nodes`` trainers).  The reference runs typed PS/worker
+        node groups; on TPU the trainer pool is the rendezvous world and
+        auxiliary pools (coworker preprocessing, embedding-service
+        hosts) are supervised/repaired but never join the training
+        rendezvous or the auto-scaler's sizing."""
         if heartbeat_timeout:
             self.HEARTBEAT_TIMEOUT = heartbeat_timeout
         self._lock = threading.Lock()
         self._nodes: Dict[int, NodeState] = {
             i: NodeState(i, max_relaunches) for i in range(num_nodes)
         }
+        self._pool_bases: Dict[str, int] = {"worker": 0}
+        for k, (pool, size) in enumerate(sorted((pools or {}).items())):
+            base = (k + 1) * self.POOL_ID_STRIDE
+            self._pool_bases[pool] = base
+            for i in range(size):
+                self._nodes[base + i] = NodeState(
+                    base + i, max_relaunches, node_type=pool
+                )
         self._launcher = launcher or NodeLauncher()
         self._max_relaunches = max_relaunches
+        # Migrations in flight: new_id -> old_id (retire the old host
+        # once its replacement reports in).
+        self._migrations: Dict[int, int] = {}
         # Event callbacks: fn(node_id, old_status, new_status).
         self._callbacks: List[Callable[[int, NodeStatus, NodeStatus], None]] = []
         self.job_failed = False
         self.job_failure_reason = ""
+
+    def _pool_for_id(self, node_id: int) -> str:
+        """The ONE id->pool rule (stride ranges, "worker" otherwise) —
+        shared by every classifier so they cannot diverge."""
+        for pool, base in self._pool_bases.items():
+            if base <= node_id < base + self.POOL_ID_STRIDE:
+                return pool
+        return "worker"
+
+    def pool_of(self, node_id: int) -> str:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                return node.node_type
+        return self._pool_for_id(node_id)
 
     def add_callback(self, fn: Callable[[int, NodeStatus, NodeStatus], None]):
         self._callbacks.append(fn)
@@ -155,10 +196,14 @@ class NodeManager:
 
     def ensure_node(self, node_id: int) -> NodeState:
         if node_id not in self._nodes:
-            self._nodes[node_id] = NodeState(node_id, self._max_relaunches)
+            self._nodes[node_id] = NodeState(
+                node_id, self._max_relaunches,
+                node_type=self._pool_for_id(node_id),
+            )
         return self._nodes[node_id]
 
     def report_event(self, node_id: int, event: str, detail: str = ""):
+        migrated_out = None
         with self._lock:
             node = self.ensure_node(node_id)
             node.last_heartbeat = time.time()
@@ -170,16 +215,34 @@ class NodeManager:
             }
             if event in mapping:
                 self._transition(node, mapping[event])
+            if event == "started":
+                migrated_out = self._complete_migration_locked(node_id)
             if event == "failed":
                 node.error = detail
-                self._maybe_relaunch(node)
+                if node_id in self._migrations.values():
+                    # The draining side of an in-flight migration: its
+                    # replacement is already coming up — relaunching the
+                    # old id would create a VM only to tear it down when
+                    # the replacement reports in, and burn budget.
+                    logger.info(
+                        "node %d failed mid-migration; replacement "
+                        "already in flight, not relaunching", node_id,
+                    )
+                else:
+                    self._maybe_relaunch(node)
+        if migrated_out is not None:
+            self._launcher.delete(migrated_out)
 
     def report_heartbeat(self, node_id: int, timestamp: float):
+        migrated_out = None
         with self._lock:
             node = self.ensure_node(node_id)
             node.last_heartbeat = timestamp
             if node.status == NodeStatus.PENDING:
                 self._transition(node, NodeStatus.RUNNING)
+                migrated_out = self._complete_migration_locked(node_id)
+        if migrated_out is not None:
+            self._launcher.delete(migrated_out)
 
     def report_failure(
         self, node_id: int, error: str, exit_code: int, level: str
@@ -288,9 +351,80 @@ class NodeManager:
                         newly_dead.append(node.node_id)
         return newly_dead
 
-    def statuses(self) -> Dict[int, str]:
+    def statuses(self, pool: Optional[str] = None) -> Dict[int, str]:
         with self._lock:
-            return {i: n.status.value for i, n in self._nodes.items()}
+            return {
+                i: n.status.value for i, n in self._nodes.items()
+                if pool is None or n.node_type == pool
+            }
+
+    def migrate(self, node_id: int) -> Optional[int]:
+        """Typed-pool migration (ref the PS migration flow): launch a
+        REPLACEMENT host at a fresh id in the same pool, drain the
+        original (PREEMPTING — it keeps serving until the replacement
+        reports started, then it is retired).  Returns the new id, or
+        None when the node is unknown."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return None
+            base = self._pool_bases.get(node.node_type, 0)
+            peers = [
+                i for i, n in self._nodes.items()
+                if n.node_type == node.node_type
+            ]
+            new_id = max(peers) + 1
+            if new_id >= base + self.POOL_ID_STRIDE:
+                logger.error(
+                    "pool %r id space exhausted", node.node_type
+                )
+                return None
+            self._nodes[new_id] = NodeState(
+                new_id, self._max_relaunches, node_type=node.node_type
+            )
+            self._migrations[new_id] = node_id
+            self._transition(node, NodeStatus.PREEMPTING)
+        try:
+            self._launcher.launch(new_id)
+        except Exception as e:  # noqa: BLE001 - cloud APIs fail transiently
+            # Roll back: a failed replacement launch must not strand the
+            # original in PREEMPTING with a dangling migration entry.
+            logger.error(
+                "migration launch of node %d failed: %s; keeping %d",
+                new_id, e, node_id,
+            )
+            with self._lock:
+                self._migrations.pop(new_id, None)
+                replacement = self._nodes.get(new_id)
+                if replacement is not None:
+                    self._transition(replacement, NodeStatus.DEAD)
+                original = self._nodes.get(node_id)
+                if original is not None and (
+                    original.status == NodeStatus.PREEMPTING
+                ):
+                    self._transition(original, NodeStatus.RUNNING)
+            return None
+        logger.info(
+            "migrating node %d -> %d (pool %s)", node_id, new_id,
+            node.node_type,
+        )
+        return new_id
+
+    def _complete_migration_locked(self, new_id: int) -> Optional[int]:
+        """Under self._lock: retire the migrated-away node's state.
+        Returns the old id for the caller to launcher-delete OUTSIDE the
+        lock (teardown can block for seconds)."""
+        old_id = self._migrations.pop(new_id, None)
+        if old_id is None:
+            return None
+        logger.info(
+            "migration complete: replacement %d up; retiring %d",
+            new_id, old_id,
+        )
+        old = self._nodes.get(old_id)
+        if old is not None:
+            self._transition(old, NodeStatus.SUCCEEDED)
+        return old_id
 
     def snapshot(self) -> Dict[int, Dict]:
         """Consistent inventory copy for persistence/diagnosis readers."""

@@ -137,6 +137,10 @@ def _master_kwargs_from_spec(spec) -> dict:
         max_relaunches=spec.master.max_relaunches,
         state_path=spec.master.state_path,
         brain_overrides=_dc.asdict(spec.brain),
+        pools=(
+            {"coworker": spec.nodes.coworkers}
+            if spec.nodes.coworkers else None
+        ),
     )
 
 

@@ -83,3 +83,54 @@ def test_coworker_sample_error_surfaces_with_surviving_workers():
                 pass
     finally:
         loader.close()
+
+
+class _HangingSample:
+    """Picklable sample_fn that never returns (wedged-worker simulator)."""
+
+    def __call__(self, index):
+        import time
+
+        time.sleep(3600)
+
+
+def test_stalled_pipeline_raises_instead_of_hanging():
+    """Live-but-wedged workers (e.g. a forked child deadlocked on an
+    inherited lock) must surface as an error, never an infinite hang —
+    the agent restarts a crashed trainer; nothing rescues a hung one."""
+    loader = CoworkerDataLoader(
+        _HangingSample(), batch_size=2, num_workers=1,
+        slot_bytes=1 << 16, stall_timeout_s=3.0,
+    )
+    try:
+        with pytest.raises(RuntimeError, match="stalled"):
+            next(iter(loader))
+    finally:
+        loader.close()
+
+
+def test_unpicklable_sample_fn_falls_back_to_fork():
+    captured = {}
+    local = 3
+
+    def closure_fn(index):
+        return {"x": np.full((2,), index + local, np.int32)}
+
+    loader = CoworkerDataLoader(
+        closure_fn, batch_size=2, num_workers=1, slot_bytes=1 << 16
+    )
+    assert loader.start_method == "fork"
+    try:
+        batch = next(iter(loader))
+        np.testing.assert_array_equal(batch["x"][0], [3, 3])
+    finally:
+        loader.close()
+
+
+def test_picklable_sample_fn_uses_spawn():
+    loader = CoworkerDataLoader(
+        synthetic_lm_sample_fn(vocab_size=7, seq_len=4),
+        batch_size=2, num_workers=1, slot_bytes=1 << 16,
+    )
+    assert loader.start_method == "spawn"
+    loader.close()

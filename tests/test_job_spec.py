@@ -316,3 +316,148 @@ def test_job_phase_failed():
         assert master.job_phase() == "failed"
     finally:
         master.stop()
+
+
+def test_typed_pools_and_migration():
+    """Typed node pools (ref PS/worker typed managers, ps.py:369 /
+    worker.py:307): a coworker pool is bootstrapped and repaired beside
+    the trainers but stays out of the scaler's sizing, and a pool node
+    can MIGRATE — replacement launched, original drained and retired
+    once the replacement reports in."""
+    from dlrover_tpu.master.cloud_launcher import (
+        CloudNodeLauncher,
+        FakeTpuVmClient,
+    )
+    from dlrover_tpu.master.job_master import JobMaster
+    from dlrover_tpu.master.node_manager import NodeManager
+
+    base = NodeManager.POOL_ID_STRIDE
+    client = FakeTpuVmClient()
+    launcher = CloudNodeLauncher(client, job_name="tp")
+    master = JobMaster(
+        num_nodes=2, min_nodes=1, launcher=launcher, auto_scale=True,
+        heartbeat_timeout=3600.0, pools={"coworker": 2},
+    )
+    try:
+        nm = master.node_manager
+        assert nm.pool_of(0) == "worker"
+        assert nm.pool_of(base) == "coworker"
+        assert sorted(nm.statuses(pool="coworker")) == [base, base + 1]
+        master.bootstrap_nodes()
+        import time as _t
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and len(client.instances) < 4:
+            _t.sleep(0.05)
+        # All four hosts (2 trainers + 2 coworkers) were created.
+        assert len(client.instances) == 4
+
+        # The scaler sizes the WORKER pool only.
+        for n in (0, 1):
+            nm.report_event(n, "started")
+        master.auto_scaler.set_target(1, reason="test")
+        plan = master.auto_scaler.step()
+        assert plan is not None and plan.delete == [1]  # never a coworker
+
+        # Migration: replacement comes up, original drains then retires.
+        nm.report_event(base, "started")
+        new_id = nm.migrate(base)
+        assert new_id == base + 2
+        assert nm.statuses()[base] == "preempting"  # still serving
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and (
+            f"tp-worker-{new_id}" not in client.instances
+        ):
+            _t.sleep(0.05)
+        nm.report_event(new_id, "started")   # replacement checks in
+        assert nm.statuses()[base] == "succeeded"  # original retired
+        assert "tp-worker-10000" in client.delete_calls
+    finally:
+        master.stop()
+        launcher.shutdown()
+
+
+def test_spec_coworker_pool_flows_to_master(tmp_path):
+    from dlrover_tpu.run import _master_kwargs_from_spec
+
+    path = tmp_path / "j.toml"
+    path.write_text(
+        'api_version = "dlrover-tpu/v1"\njob_name = "j"\n'
+        "[nodes]\nmin = 1\nmax = 2\ncoworkers = 3\n"
+    )
+    kwargs = _master_kwargs_from_spec(load_job_spec(str(path)))
+    assert kwargs["pools"] == {"coworker": 3}
+
+
+def test_pool_node_heartbeat_death_repaired_under_scaler():
+    """Code-review r5: the scaler is worker-pool-scoped, so a coworker
+    host dying by heartbeat timeout must be relaunched by the master's
+    death handler — not silently left DEAD forever."""
+    from dlrover_tpu.master.job_master import JobMaster
+    from dlrover_tpu.master.node_manager import NodeManager
+
+    base = NodeManager.POOL_ID_STRIDE
+    master = JobMaster(
+        num_nodes=2, min_nodes=1, auto_scale=True,
+        heartbeat_timeout=0.5, pools={"coworker": 1},
+    )
+    try:
+        nm = master.node_manager
+        import time as _t
+        nm.report_event(base, "started")
+        nm.ensure_node(base).last_heartbeat = _t.time() - 10
+        dead = nm.check_heartbeats()
+        assert dead == [base]
+        master._handle_node_death(base)
+        # Relaunched (budget-limited), not abandoned.
+        assert nm.statuses()[base] == "pending"
+    finally:
+        master.stop()
+
+
+def test_migration_survives_failed_old_node_and_failed_launch():
+    from dlrover_tpu.master.node_manager import NodeLauncher, NodeManager
+
+    class FlakyLauncher(NodeLauncher):
+        def __init__(self):
+            self.fail_next_launch = False
+            self.launched, self.deleted = [], []
+
+        def launch(self, node_id):
+            if self.fail_next_launch:
+                self.fail_next_launch = False
+                raise RuntimeError("quota")
+            self.launched.append(node_id)
+
+        def delete(self, node_id):
+            self.deleted.append(node_id)
+
+    launcher = FlakyLauncher()
+    nm = NodeManager(num_nodes=1, launcher=launcher,
+                     pools={"coworker": 1})
+    base = NodeManager.POOL_ID_STRIDE
+    nm.report_event(base, "started")
+
+    # Replacement launch fails -> full rollback, original keeps serving.
+    launcher.fail_next_launch = True
+    assert nm.migrate(base) is None
+    assert nm.statuses()[base] == "running"
+    assert not nm._migrations
+
+    # Successful migration; the draining original then reports failed:
+    # no relaunch at the old id (its replacement is already in flight).
+    new_id = nm.migrate(base)
+    assert new_id is not None
+    launched_before = list(launcher.launched)
+    nm.report_event(base, "failed", "preempted")
+    assert launcher.launched == launched_before  # no old-id relaunch
+    nm.report_event(new_id, "started")
+    assert base in launcher.deleted  # original retired on completion
+
+
+def test_pool_classifiers_agree_out_of_range():
+    from dlrover_tpu.master.node_manager import NodeManager
+
+    nm = NodeManager(num_nodes=1, pools={"coworker": 2})
+    weird = 2 * NodeManager.POOL_ID_STRIDE + 5  # outside every pool range
+    assert nm.pool_of(weird) == "worker"
+    assert nm.ensure_node(weird).node_type == "worker"

@@ -95,6 +95,7 @@ class Candidate:
     microbatches: int = 0                    # 0 = pipe default
     quantized_dcn: bool = False              # int8 DCN collectives
     interleave: int = 0                      # 0/1 = plain; v>=2 circular
+    fused_ln: bool = False                   # Pallas one-pass LN backward
     est_step_time: float = math.inf
     est_hbm_gb: float = math.inf
     measured_step_time: Optional[float] = None
@@ -118,6 +119,8 @@ class Candidate:
             extras += f" mb={self.microbatches}"
         if self.interleave > 1:
             extras += f" il={self.interleave}"
+        if self.fused_ln:
+            extras += " fln"
         if self.quantized_dcn:
             extras += " q8dcn"
         return f"[{live or 'dp=1'} remat={self.remat}{batch}{extras}]"
@@ -173,6 +176,7 @@ def _knob_space(
     else:
         blocks = [(0, 0)]
     ce_options = [0, 16] if search_kernels else [0]
+    fln = [False, True] if search_kernels else [False]
     if pipe > 1:
         micro = [pipe, 2 * pipe, 4 * pipe]
         # Circular interleave (parallel/pipeline.py _circular): v=2 cuts
@@ -190,9 +194,9 @@ def _knob_space(
     dcn = [False, True] if (search_kernels and multihost) else [False]
     return [
         {"flash_block": fb, "ce_chunks": ce, "microbatches": mb,
-         "quantized_dcn": q, "interleave": v}
+         "quantized_dcn": q, "interleave": v, "fused_ln": f}
         for fb in blocks for ce in ce_options for mb in micro for q in dcn
-        for v in il
+        for v in il for f in fln
     ]
 
 
@@ -355,6 +359,11 @@ def _estimate(
         t_compute += 3 * (n * 2 / shard) / hbm_bw
     # HBM: weights stream fwd+bwd+update, activations twice
     t_hbm = (param_b * 6 + opt_b + act_b * 2) / hbm_bw
+    # Fused LN backward (ops/fused_norm.py): the XLA LN-bwd fusions
+    # re-read the layer activations ~once more than the one-pass
+    # kernel does (PROFILE.md r4's 6.4 ms/layer sink).
+    if cand.fused_ln:
+        t_hbm -= act_b * 0.3 / hbm_bw
     # ICI: fsdp all-gather + reduce-scatter of params, dp grad all-reduce,
     # sp/ep all-to-alls of activations
     coll_b = 0.0
@@ -422,6 +431,7 @@ def _measure(
             if cand.parallel.pipe > 1 else 0
         ),
         pipeline_interleave=max(cand.interleave, 1),
+        fused_ln=cand.fused_ln,
     )
     if cand.flash_block != (0, 0):
         overrides["flash_block_q"] = cand.flash_block[0]
@@ -465,7 +475,7 @@ def _cand_key(c: Candidate):
     return (
         p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor, c.remat,
         c.global_batch_size, c.flash_block, c.ce_chunks, c.microbatches,
-        c.quantized_dcn, c.interleave,
+        c.quantized_dcn, c.interleave, c.fused_ln,
     )
 
 
@@ -521,7 +531,8 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
          _REMAT_CODES[best.remat], best.global_batch_size,
          best.flash_block[0], best.flash_block[1], best.ce_chunks,
-         best.microbatches, int(best.quantized_dcn), best.interleave],
+         best.microbatches, int(best.quantized_dcn), best.interleave,
+         int(best.fused_ln)],
         np.int64,
     )
     agreed = multihost_utils.broadcast_one_to_all(key)
@@ -545,6 +556,7 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
         microbatches=int(agreed[11]),
         quantized_dcn=bool(agreed[12]),
         interleave=int(agreed[13]),
+        fused_ln=bool(agreed[14]),
     )
     for cand in ranked:
         if (
@@ -757,6 +769,7 @@ def auto_tune(
             if best.parallel.pipe > 1 else 0
         ),
         pipeline_interleave=max(best.interleave, 1),
+        fused_ln=best.fused_ln,
     )
     if best.flash_block != (0, 0):
         cfg_overrides["flash_block_q"] = best.flash_block[0]

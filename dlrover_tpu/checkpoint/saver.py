@@ -101,11 +101,23 @@ class AsyncCheckpointSaver:
 
     def stop(self, unlink_shm: bool = False):
         """``unlink_shm=True`` only on clean job success — after a failure the
-        arena must survive for the save-at-breakpoint / resume path."""
-        self._stopped.set()
+        arena must survive for the save-at-breakpoint / resume path.
+
+        EXIT is processed IN QUEUE ORDER, after any still-queued SAVE
+        events: setting the stop flag first would make the loop drop a
+        just-enqueued final checkpoint (and, with unlink_shm, delete the
+        only copy) whenever shutdown raced the persist — seen as a
+        loaded-host flake where the last ckpt_every save never reached
+        disk.  The flag is set only if the thread fails to drain in time.
+        """
         self._event_queue.put(CheckpointEvent(CheckpointEventType.EXIT))
         if self._thread:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                logger.warning(
+                    "saver did not drain within 30s; forcing stop"
+                )
+        self._stopped.set()
         self._event_queue.close()
         self._lock.close()
         self._status.close()
@@ -148,9 +160,11 @@ class AsyncCheckpointSaver:
             "async saver started (host %d/%d) -> %s",
             self.host_index, self.num_hosts, self.checkpoint_dir,
         )
-        while not self._stopped.is_set():
+        while True:
             event = self._event_queue.get(timeout=1.0)
             if event is None:
+                if self._stopped.is_set():
+                    break  # backstop: forced stop after a failed drain
                 continue
             if event.type == CheckpointEventType.EXIT:
                 break

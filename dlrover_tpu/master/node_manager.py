@@ -304,6 +304,17 @@ class NodeManager:
         """
         with self._lock:
             node = self.ensure_node(node_id)
+            if node_id in self._migrations.values():
+                # The draining side of an in-flight migration (it may
+                # have gone silent — the normal preemption signature):
+                # its replacement is already coming up; relaunching here
+                # would burn budget on a VM the completion hook then
+                # tears straight down.
+                logger.info(
+                    "node %d is mid-migration; replacement in flight, "
+                    "not relaunching", node_id,
+                )
+                return True
             if node.status == NodeStatus.RUNNING or (
                 node.status == NodeStatus.PENDING and not bootstrap
             ):
@@ -395,9 +406,9 @@ class NodeManager:
             )
             with self._lock:
                 self._migrations.pop(new_id, None)
-                replacement = self._nodes.get(new_id)
-                if replacement is not None:
-                    self._transition(replacement, NodeStatus.DEAD)
+                # Remove, don't mark DEAD: a dead orphan NodeState would
+                # pin all_succeeded()/statuses() forever.
+                self._nodes.pop(new_id, None)
                 original = self._nodes.get(node_id)
                 if original is not None and (
                     original.status == NodeStatus.PREEMPTING
@@ -463,7 +474,12 @@ class NodeManager:
         return True
 
     def all_succeeded(self) -> bool:
+        """Worker-pool success only (same scoping as ``job_phase``):
+        auxiliary pools serve the workers and never reach SUCCEEDED —
+        counting them would make a finished job look unfinished forever."""
         with self._lock:
             return all(
-                n.status == NodeStatus.SUCCEEDED for n in self._nodes.values()
+                n.status == NodeStatus.SUCCEEDED
+                for n in self._nodes.values()
+                if n.node_type == "worker"
             )

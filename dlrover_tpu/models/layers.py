@@ -149,49 +149,62 @@ class Embed(nn.Module):
 
 
 class RMSNorm(nn.Module):
-    """Root-mean-square norm (Llama-style), fp32 accumulation."""
+    """Root-mean-square norm (Llama-style), fp32 accumulation.
+
+    ``fused_backward``: one-pass Pallas backward (ops/fused_norm.py) —
+    same flag semantics as :class:`LayerNorm`.
+    """
 
     epsilon: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
+    fused_backward: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         orig_dtype = x.dtype
-        x32 = x.astype(jnp.float32)
         scale = self.param(
             "scale",
             nn.with_logical_partitioning(nn.initializers.ones_init(), (lax_rules.NORM,)),
             (x.shape[-1],),
             self.param_dtype,
         )
+        if self.fused_backward:
+            from dlrover_tpu.ops.fused_norm import fused_rmsnorm
+
+            return fused_rmsnorm(x, scale, self.epsilon)
+        x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         y = x32 * jax.lax.rsqrt(var + self.epsilon)
         return (y * scale.astype(jnp.float32)).astype(orig_dtype)
 
 
 class LayerNorm(nn.Module):
-    """Standard layernorm (GPT-2 style), fp32 accumulation."""
+    """Standard layernorm (GPT-2 style), fp32 accumulation.
+
+    ``fused_backward``: route through ops/fused_norm.py's custom_vjp so
+    the backward is a single Pallas pass over (x, dy) instead of XLA's
+    multi-fusion re-reads (PROFILE.md r4's 6.4 ms/layer LN-bwd sink).
+    Off by default until the on-chip trace prices it (r5: unmeasured,
+    relay down).
+    """
 
     epsilon: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     use_bias: bool = True
+    fused_backward: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         orig_dtype = x.dtype
-        x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
-        y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
         scale = self.param(
             "scale",
             nn.with_logical_partitioning(nn.initializers.ones_init(), (lax_rules.NORM,)),
             (x.shape[-1],),
             self.param_dtype,
         )
-        y = y * scale.astype(jnp.float32)
+        bias = None
         if self.use_bias:
             bias = self.param(
                 "bias",
@@ -201,15 +214,28 @@ class LayerNorm(nn.Module):
                 (x.shape[-1],),
                 self.param_dtype,
             )
+        if self.fused_backward:
+            from dlrover_tpu.ops.fused_norm import fused_layernorm
+
+            return fused_layernorm(x, scale, bias, self.epsilon)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * scale.astype(jnp.float32)
+        if bias is not None:
             y = y + bias.astype(jnp.float32)
         return y.astype(orig_dtype)
 
 
-def make_norm(kind: str, dtype: Dtype, param_dtype: Dtype, name: str) -> nn.Module:
+def make_norm(kind: str, dtype: Dtype, param_dtype: Dtype, name: str,
+              fused_backward: bool = False) -> nn.Module:
     if kind == "rmsnorm":
-        return RMSNorm(dtype=dtype, param_dtype=param_dtype, name=name)
+        return RMSNorm(dtype=dtype, param_dtype=param_dtype, name=name,
+                       fused_backward=fused_backward)
     if kind == "layernorm":
-        return LayerNorm(dtype=dtype, param_dtype=param_dtype, name=name)
+        return LayerNorm(dtype=dtype, param_dtype=param_dtype, name=name,
+                         fused_backward=fused_backward)
     raise ValueError(f"unknown norm kind {kind!r}")
 
 

@@ -72,6 +72,10 @@ class TransformerConfig:
     # Store the MLP wo kernel transposed [d_model, d_ff] (emitter
     # experiment, PROFILE.md r4).  Checkpoint-format change when True.
     wo_transposed: bool = False
+    # One-pass Pallas LayerNorm backward (ops/fused_norm.py): attacks the
+    # 6.4 ms/layer LN-bwd sink.  Numerics-tested; on-chip speedup
+    # unmeasured as of r5 (relay down) — off until a trace prices it.
+    fused_ln: bool = False
     remat: str = "none"            # one of _REMAT_POLICIES below: "none",
                                    # "dots", "dots_no_batch", "full",
                                    # "attn_out", "branch_out", "flash_res",
@@ -250,7 +254,8 @@ class Block(nn.Module):
         x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
         if cfg.pin_attn_layouts:
             x = pin_layout(x)
-        y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_attn")(x)
+        y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_attn",
+                     fused_backward=cfg.fused_ln)(x)
         y = Attention(
             num_heads=cfg.num_heads,
             num_kv_heads=cfg.resolved_kv_heads,
@@ -275,7 +280,8 @@ class Block(nn.Module):
         # recompute) at b*s*d bf16 per layer of extra HBM.
         y = jax.ad_checkpoint.checkpoint_name(y, "attn_out")
         x = x + y
-        y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_mlp")(x)
+        y = layers.make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_mlp",
+                     fused_backward=cfg.fused_ln)(x)
         if cfg.num_experts:
             y, layer_aux = MoEMlp(
                 num_experts=cfg.num_experts,

@@ -1,0 +1,184 @@
+"""Fused Pallas LayerNorm backward (PROFILE.md r4 sink: 6.4 ms/layer of
+LN-bwd fusions): one pass over (x, dy) produces dx + dscale + dbias.
+
+Numerics-verified here (interpret mode on CPU); the on-chip speedup is
+measured separately (PROFILE.md) and the model flag stays off until
+priced.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.fused_norm import fused_layernorm
+
+EPS = 1e-5
+
+
+def _reference(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + EPS) * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+@pytest.mark.parametrize("rows,d", [(64, 256), (100, 384)])  # ragged rows
+def test_fused_ln_grads_match_reference(rows, d):
+    key = jax.random.PRNGKey(0)
+    kx, ks, kb, kd = jax.random.split(key, 4)
+    x = jax.random.normal(kx, (rows, d), jnp.float32) * 2.0 + 0.5
+    scale = jax.random.normal(ks, (d,), jnp.float32) * 0.3 + 1.0
+    bias = jax.random.normal(kb, (d,), jnp.float32) * 0.1
+    dy = jax.random.normal(kd, (rows, d), jnp.float32)
+
+    def loss_ref(x, scale, bias):
+        return jnp.sum(_reference(x, scale, bias) * dy)
+
+    def loss_fused(x, scale, bias):
+        return jnp.sum(
+            fused_layernorm(x, scale, bias, EPS, 32) * dy
+        )
+
+    ref = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    for r, g, name in zip(ref, got, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-4, atol=2e-4,
+            err_msg=name,
+        )
+
+
+def test_fused_ln_no_bias_and_batched_shape():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 128), jnp.float32)
+    scale = jnp.ones((128,)) * 1.5
+    dy = jax.random.normal(jax.random.PRNGKey(2), x.shape, jnp.float32)
+
+    ref = jax.grad(
+        lambda x, s: jnp.sum(_reference(x, s, None) * dy), argnums=(0, 1)
+    )(x, scale)
+    got = jax.grad(
+        lambda x, s: jnp.sum(
+            fused_layernorm(x, s, None, EPS, 16) * dy
+        ),
+        argnums=(0, 1),
+    )(x, scale)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_fused_ln_forward_matches_and_bf16_roundtrip():
+    x = (
+        jax.random.normal(jax.random.PRNGKey(3), (32, 256), jnp.float32)
+        .astype(jnp.bfloat16)
+    )
+    scale = jnp.ones((256,))
+    bias = jnp.zeros((256,))
+    y = fused_layernorm(x, scale, bias, EPS)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(_reference(x, scale, bias), np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_model_flag_trains_with_fused_ln():
+    """fused_ln=True end-to-end: grads flow, loss finite, and the grads
+    match the unfused model's on the same params."""
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.models.transformer import TransformerLM
+    from dlrover_tpu.trainer import train_lib
+    import flax.linen as nn
+
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 64)
+    targets = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, 64)
+    grads = {}
+    for fused in (False, True):
+        cfg = gpt2_config(
+            "124m", num_layers=2, d_model=64, num_heads=2, vocab_size=64,
+            max_seq_len=16, param_dtype=jnp.float32, fused_ln=fused,
+        )
+        model = TransformerLM(cfg)
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), tokens)["params"]
+        )
+
+        def loss(p, model=model):
+            logits, _ = model.apply({"params": p}, tokens)
+            return train_lib.cross_entropy_loss(logits, targets)[0]
+
+        grads[fused] = jax.grad(loss)(params)
+    for a, b in zip(jax.tree.leaves(grads[False]), jax.tree.leaves(grads[True])):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_fused_rmsnorm_grads_match_reference():
+    from dlrover_tpu.ops.fused_norm import fused_rmsnorm
+
+    def rms_ref(x, scale):
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + EPS)
+                * scale.astype(jnp.float32)).astype(x.dtype)
+
+    x = jax.random.normal(jax.random.PRNGKey(7), (50, 256), jnp.float32)
+    scale = jax.random.normal(jax.random.PRNGKey(8), (256,)) * 0.2 + 1.0
+    dy = jax.random.normal(jax.random.PRNGKey(9), x.shape, jnp.float32)
+
+    ref = jax.grad(
+        lambda x, s: jnp.sum(rms_ref(x, s) * dy), argnums=(0, 1)
+    )(x, scale)
+    got = jax.grad(
+        lambda x, s: jnp.sum(fused_rmsnorm(x, s, EPS, 16) * dy),
+        argnums=(0, 1),
+    )(x, scale)
+    for r, g, name in zip(ref, got, ("dx", "dscale")):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-4, atol=2e-4,
+            err_msg=name,
+        )
+
+
+def test_llama_family_trains_with_fused_rmsnorm():
+    from dlrover_tpu.models.llama import llama_config
+    from dlrover_tpu.models.transformer import TransformerLM
+    from dlrover_tpu.trainer import train_lib
+    import dataclasses
+    import flax.linen as nn
+
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 64)
+    targets = jax.random.randint(jax.random.PRNGKey(5), (2, 16), 0, 64)
+    grads = {}
+    for fused in (False, True):
+        cfg = llama_config(
+            "tiny", num_layers=2, vocab_size=64, max_seq_len=16,
+        )
+        cfg = dataclasses.replace(
+            cfg, fused_ln=fused, param_dtype=jnp.float32
+        )
+        model = TransformerLM(cfg)
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), tokens)["params"]
+        )
+
+        def loss(p, model=model):
+            logits, _ = model.apply({"params": p}, tokens)
+            return train_lib.cross_entropy_loss(logits, targets)[0]
+
+        grads[fused] = jax.grad(loss)(params)
+    for a, b in zip(
+        jax.tree.leaves(grads[False]), jax.tree.leaves(grads[True])
+    ):
+        # bf16 activations: the kernel's f32 xhat recompute rounds one
+        # ulp differently from the AD chain on a fraction of elements.
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2
+        )

@@ -461,3 +461,62 @@ def test_pool_classifiers_agree_out_of_range():
     weird = 2 * NodeManager.POOL_ID_STRIDE + 5  # outside every pool range
     assert nm.pool_of(weird) == "worker"
     assert nm.ensure_node(weird).node_type == "worker"
+
+
+def test_silent_death_mid_migration_not_relaunched_and_job_completes():
+    """Code-review r5 round 2: a draining node that goes SILENT (the
+    normal preemption signature) must not be relaunched at its old id,
+    and pool nodes / migration rollbacks must not pin all_succeeded."""
+    from dlrover_tpu.master.node_manager import NodeLauncher, NodeManager
+
+    class Recorder(NodeLauncher):
+        def __init__(self):
+            self.launched, self.deleted = [], []
+
+        def launch(self, node_id):
+            self.launched.append(node_id)
+
+        def delete(self, node_id):
+            self.deleted.append(node_id)
+
+    launcher = Recorder()
+    nm = NodeManager(num_nodes=1, launcher=launcher,
+                     pools={"coworker": 1}, heartbeat_timeout=0.5)
+    base = NodeManager.POOL_ID_STRIDE
+    import time as _t
+    nm.report_event(0, "started")
+    nm.report_event(base, "started")
+    new_id = nm.migrate(base)
+    # The draining original goes silent; heartbeat death must NOT
+    # relaunch it (replacement in flight).
+    nm.ensure_node(base).last_heartbeat = _t.time() - 10
+    assert base in nm.check_heartbeats()
+    launched_before = list(launcher.launched)
+    assert nm.launch_node(base)  # the death-handler repair path
+    assert launcher.launched == launched_before
+    nm.report_event(new_id, "started")
+
+    # Worker succeeded -> job succeeded, coworkers notwithstanding.
+    nm.report_event(0, "succeeded")
+    assert nm.all_succeeded()
+
+
+def test_migration_rollback_leaves_no_orphan():
+    from dlrover_tpu.master.node_manager import NodeLauncher, NodeManager
+
+    class Failing(NodeLauncher):
+        def launch(self, node_id):
+            raise RuntimeError("quota")
+
+        def delete(self, node_id):
+            pass
+
+    nm = NodeManager(num_nodes=1, launcher=Failing(),
+                     pools={"coworker": 1})
+    base = NodeManager.POOL_ID_STRIDE
+    nm.report_event(base, "started")
+    assert nm.migrate(base) is None
+    # No DEAD orphan replacement node left behind.
+    assert sorted(nm.statuses(pool="coworker")) == [base]
+    nm.report_event(0, "succeeded")
+    assert nm.all_succeeded()

@@ -38,6 +38,7 @@ import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.transformer import TransformerConfig
+from dlrover_tpu.ops import remat_policy as remat_policy_lib
 from dlrover_tpu.runtime.mesh import ParallelConfig
 
 # Per-chip peak specs used by the analytic model; CPU entries make ranking
@@ -63,24 +64,27 @@ def chip_specs(device=None) -> Tuple[float, float, float, float]:
     )
 
 
-# Bytes of saved activation per token per layer under each remat policy
-# (bf16 residual stream multiples; see models/transformer.py policies).
-_ACT_PER_TOKEN_LAYER = {
-    "full": 1.0,        # scan carry only
-    "attn_out": 2.0,    # carry + attention branch output
-    "branch_out": 3.0,  # carry + both branch outputs
-    "dots": 8.0,        # all matmul outputs (qkv + attn + proj + wi + wo)
-    "none": 12.0,       # everything incl. elementwise
+# Sustained host<->HBM DMA bandwidth per chip (one direction).  TPU VMs
+# pin activation staging buffers, but the PCIe/host link is far below HBM
+# bandwidth — this is THE number the offload-vs-recompute trade hinges
+# on, and it is deliberately conservative until the relay window measures
+# it (PROFILE.md "Remat policies").
+_HOST_DMA_BW = {
+    "tpu v5 lite": 15e9,
+    "tpu v5e": 15e9,
+    "tpu v5p": 32e9,
+    "tpu v4": 32e9,
+    "cpu": 10e9,  # virtual-mesh tests: keep the trade meaningful, not free
 }
 
-# Fraction of forward matmul FLOPs recomputed in the backward per policy.
-_RECOMPUTE_FRACTION = {
-    "full": 1.0,
-    "attn_out": 0.85,
-    "branch_out": 0.7,
-    "dots": 0.3,
-    "none": 0.0,
-}
+
+def host_dma_bandwidth(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", device.platform).lower()
+    for key, bw in _HOST_DMA_BW.items():
+        if key in kind:
+            return bw
+    return _HOST_DMA_BW["cpu"] if device.platform == "cpu" else 15e9
 
 
 @dataclasses.dataclass
@@ -98,6 +102,12 @@ class Candidate:
     fused_ln: bool = False                   # Pallas one-pass LN backward
     est_step_time: float = math.inf
     est_hbm_gb: float = math.inf
+    # Accounting components the remat choice trades against each other
+    # (ops/remat_policy.py): backward recompute time vs host<->HBM DMA
+    # time for offloaded activations.  Exposed so tests (and operators
+    # reading the candidate table) can see WHY a policy won.
+    est_recompute_time: float = 0.0
+    est_dma_time: float = 0.0
     measured_step_time: Optional[float] = None
     measured_tokens_per_sec: Optional[float] = None
     rejected: str = ""
@@ -225,13 +235,29 @@ def enumerate_candidates(
     """
     heads = config.num_heads
     seq_len = seq_len or config.max_seq_len
+    if search_kernels:
+        # The remat policy is a searchable kernel-class knob like flash
+        # blocks / CE chunking: widen with host offload (and the flash
+        # residual policies where the flash names exist) so the chip
+        # arbitrates the recompute-vs-DMA trade empirically.
+        extra = ["offload"]
+        if config.attention_impl == "flash":
+            extra += ["flash_only", "flash_res"]
+        remat_policies = tuple(remat_policies) + tuple(
+            r for r in extra if r not in remat_policies
+        )
     # Validate up front, identically on every host: a policy without a
     # broadcast code raising only on the hosts whose measured best uses it
     # would leave the others hung in broadcast_one_to_all.
-    uncoded = [r for r in remat_policies if r not in _REMAT_CODES]
+    uncoded = []
+    for r in remat_policies:
+        try:
+            _encode_remat(r)
+        except ValueError:
+            uncoded.append(r)
     if uncoded:
         raise ValueError(
-            f"remat policies {uncoded} have no _REMAT_CODES entry; "
+            f"remat policies {uncoded} have no broadcast encoding; "
             "multihost choice broadcast would diverge"
         )
     candidates: List[Candidate] = []
@@ -294,6 +320,7 @@ def _estimate(
     folded constants (measured on v5e, PROFILE.md).
     """
     peak_flops, hbm_bw, hbm_bytes, ici_bw = chip_specs()
+    policy = remat_policy_lib.resolve(cand.remat)
     p = cand.parallel
     n = config.num_params()
     tokens = global_batch_size * seq_len
@@ -305,10 +332,20 @@ def _estimate(
     opt_mult = {"adamw": 8.0, "adafactor": 0.2, "q8_adam": 2.2,
                 "q4_adam": 1.25, "sgd": 4.0, "lion": 4.0}.get(optimizer, 8.0)
     opt_b = n * opt_mult / shard
-    act_mult = _ACT_PER_TOKEN_LAYER.get(cand.remat, 4.0)
+    act_mult = policy.hbm_act_per_token_layer
     tokens_local = tokens / max(p.data * p.fsdp, 1) / max(p.seq, 1)
     act_b = (
         tokens_local * config.num_layers * config.d_model * 2 * act_mult
+        / max(p.tensor, 1) / max(p.pipe, 1)
+    )
+    # Host-offloaded activations (offload-family policies): zero HBM
+    # residency, but every byte crosses the host DMA link twice per step
+    # (park at forward, fetch at backward).  Priced at the policy's
+    # intended semantics even where the local backend would fall back to
+    # save-only — the plan is for the target chip, not the test mesh.
+    offload_b = (
+        tokens_local * config.num_layers * config.d_model * 2
+        * policy.offload_bytes_per_token_layer
         / max(p.tensor, 1) / max(p.pipe, 1)
     )
     # transient working set (attention + MLP blocks)
@@ -331,11 +368,18 @@ def _estimate(
 
     # ---- time ----
     ftok = 6 * n + 12 * config.num_layers * config.d_model * seq_len
-    flops_dev = ftok * tokens * (
-        1 + _RECOMPUTE_FRACTION.get(cand.remat, 0.5) / 3
-    ) / n_devices
+    flops_dev = ftok * tokens / n_devices
     mxu_eff = 0.55  # measured sustained efficiency at bench shapes
     t_compute = flops_dev / (peak_flops * mxu_eff)
+    # Backward recompute is SERIAL extra compute (the replay runs before
+    # the grads that need it), and the backward fetch of offloaded
+    # activations is serial DMA the same way — both are additive terms, so
+    # the offload-vs-save trade reduces to est_dma_time vs the recompute
+    # time the offload avoids.  Forward FLOPs are 1/3 of ftok.
+    t_recompute = (
+        flops_dev * policy.recompute_fraction / 3 / (peak_flops * mxu_eff)
+    )
+    t_dma = 2 * offload_b / host_dma_bandwidth()
     # Flash block sizes: measured relative attention-kernel cost on v5e at
     # seq 1024 (PROFILE.md round 3 table; one-kv-block is fastest because
     # the fused single-pass backward engages).  Attention is ~20% of the
@@ -346,7 +390,9 @@ def _estimate(
         bq, bkv = cand.flash_block
         if (bq, bkv) == (0, 0):
             bq, bkv = config.flash_block_q, config.flash_block_kv
-        t_compute *= 0.8 + 0.2 * _flash_factor(bkv, seq_len)
+        flash_scale = 0.8 + 0.2 * _flash_factor(bkv, seq_len)
+        t_compute *= flash_scale
+        t_recompute *= flash_scale
     # Chunked CE re-runs the logits matmul per chunk boundary: measured
     # +-0.5% at bench shapes — time-neutral, memory is its real effect.
     if cand.ce_chunks:
@@ -406,7 +452,11 @@ def _estimate(
         if rows_per_micro < 1:
             cand.rejected = f"microbatches {micro} > local batch rows"
             return
-    cand.est_step_time = (max(t_compute, t_hbm) + t_ici) * bubble
+    cand.est_recompute_time = t_recompute
+    cand.est_dma_time = t_dma
+    cand.est_step_time = (
+        max(t_compute, t_hbm) + t_recompute + t_dma + t_ici
+    ) * bubble
 
 
 def _measure(
@@ -511,7 +561,45 @@ def _knob_neighbors(
 
 _REMAT_CODES = {"none": 0, "full": 1, "dots": 2, "attn_out": 3,
                 "branch_out": 4, "flash_only": 5, "flash_res": 6,
-                "dots_no_batch": 7}
+                "dots_no_batch": 7, "offload": 8}
+_CODE_TO_REMAT = {v: k for k, v in _REMAT_CODES.items()}
+# Selective offload policies ("offload:<names>") encode as a bitmask over
+# remat_policy.OFFLOADABLE_NAMES above this base — an open set of names
+# needs no per-name registry entry to broadcast.
+_OFFLOAD_CODE_BASE = 100
+
+
+def _encode_remat(name: str) -> int:
+    if name in _REMAT_CODES:
+        return _REMAT_CODES[name]
+    policy = remat_policy_lib.resolve(name)  # ValueError on garbage
+    if policy.offload_names:
+        bits = 0
+        for i, n in enumerate(remat_policy_lib.OFFLOADABLE_NAMES):
+            if n in policy.offload_names:
+                bits |= 1 << i
+        return _OFFLOAD_CODE_BASE + bits
+    raise ValueError(
+        f"remat policy {name!r} has no broadcast code; add it to "
+        "_REMAT_CODES"
+    )
+
+
+def _decode_remat(code: int) -> str:
+    if code in _CODE_TO_REMAT:
+        return _CODE_TO_REMAT[code]
+    if code >= _OFFLOAD_CODE_BASE:
+        bits = code - _OFFLOAD_CODE_BASE
+        names = [
+            n for i, n in enumerate(remat_policy_lib.OFFLOADABLE_NAMES)
+            if bits & (1 << i)
+        ]
+        if names:
+            return remat_policy_lib.offload_policy_name(names)
+    raise ValueError(
+        f"broadcast remat code {code} unknown to this host "
+        "(version skew between hosts?)"
+    )
 
 
 def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
@@ -519,17 +607,12 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
     from jax.experimental import multihost_utils
 
     p = best.parallel
-    if best.remat not in _REMAT_CODES:
-        # Silently encoding an unknown policy as -1 would make non-source
-        # hosts decode it to their own local best — divergent compiled
-        # programs hang the first collective.  Fail loudly instead.
-        raise ValueError(
-            f"remat policy {best.remat!r} has no broadcast code; add it to "
-            "_REMAT_CODES"
-        )
+    # Silently encoding an unknown policy as -1 would make non-source
+    # hosts decode it to their own local best — divergent compiled
+    # programs hang the first collective.  _encode_remat fails loudly.
     key = np.asarray(
         [p.data, p.fsdp, p.pipe, p.expert, p.seq, p.tensor,
-         _REMAT_CODES[best.remat], best.global_batch_size,
+         _encode_remat(best.remat), best.global_batch_size,
          best.flash_block[0], best.flash_block[1], best.ce_chunks,
          best.microbatches, int(best.quantized_dcn), best.interleave,
          int(best.fused_ln)],
@@ -538,17 +621,11 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
     agreed = multihost_utils.broadcast_one_to_all(key)
     if np.array_equal(agreed, key):
         return best
-    codes = {v: k for k, v in _REMAT_CODES.items()}
     parallel = ParallelConfig(
         data=int(agreed[0]), fsdp=int(agreed[1]), pipe=int(agreed[2]),
         expert=int(agreed[3]), seq=int(agreed[4]), tensor=int(agreed[5]),
     )
-    if int(agreed[6]) not in codes:
-        raise ValueError(
-            f"broadcast remat code {int(agreed[6])} unknown to this host "
-            "(version skew between hosts?)"
-        )
-    remat = codes[int(agreed[6])]
+    remat = _decode_remat(int(agreed[6]))
     knobs = dict(
         global_batch_size=int(agreed[7]),
         flash_block=(int(agreed[8]), int(agreed[9])),

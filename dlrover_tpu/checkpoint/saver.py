@@ -99,6 +99,10 @@ class AsyncCheckpointSaver:
         )
         self._thread.start()
 
+    # Drain / forced-stop windows (class attrs so tests can shrink them).
+    DRAIN_TIMEOUT_S = 30.0
+    FORCED_JOIN_TIMEOUT_S = 5.0
+
     def stop(self, unlink_shm: bool = False):
         """``unlink_shm=True`` only on clean job success — after a failure the
         arena must survive for the save-at-breakpoint / resume path.
@@ -112,11 +116,28 @@ class AsyncCheckpointSaver:
         """
         self._event_queue.put(CheckpointEvent(CheckpointEventType.EXIT))
         if self._thread:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=self.DRAIN_TIMEOUT_S)
             if self._thread.is_alive():
                 logger.warning(
-                    "saver did not drain within 30s; forcing stop"
+                    "saver did not drain within %.0fs; forcing stop",
+                    self.DRAIN_TIMEOUT_S,
                 )
+                self._stopped.set()
+                # Give the forced-stop flag a chance to break the loop (or
+                # an in-flight persist to finish) before touching shared
+                # state.
+                self._thread.join(timeout=self.FORCED_JOIN_TIMEOUT_S)
+                if self._thread.is_alive():
+                    # The worker may be mid-persist INSIDE the shared
+                    # queue/lock/status/shm; closing them under it would
+                    # corrupt the write or raise in the worker.  Leak the
+                    # handles instead — the process is exiting anyway and
+                    # a restarted saver re-creates them.
+                    logger.error(
+                        "saver thread still alive after forced stop; "
+                        "leaving shared queue/lock/status/shm open"
+                    )
+                    return
         self._stopped.set()
         self._event_queue.close()
         self._lock.close()

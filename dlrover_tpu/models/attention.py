@@ -35,7 +35,9 @@ from dlrover_tpu.runtime.mesh import (
     FSDP_AXIS,
     SEQ_AXIS,
     TENSOR_AXIS,
+    current_mesh,
     mesh_axis_size,
+    shard_map_compat,
 )
 
 NEG_INF = -1e15
@@ -62,7 +64,7 @@ def ulysses_attention(
     rematerialization" (replicate + repartition) on the boundary reshapes
     — the explicit collective compiles to a clean ICI all-to-all instead.
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = current_mesh()
     batch_spec = (DATA_AXIS, FSDP_AXIS)
     io_spec = P(batch_spec, SEQ_AXIS, TENSOR_AXIS, None)
     specs = [io_spec, io_spec, io_spec]
@@ -72,11 +74,10 @@ def ulysses_attention(
         args.append(segment_ids)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=tuple(specs),
         out_specs=io_spec,
-        check_vma=False,
     )
     def inner(q, k, v, seg=None):
         swap = functools.partial(
@@ -203,6 +204,10 @@ class Attention(nn.Module):
                 use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                # Remat saveable: under offload-family policies the fused
+                # projection output moves to pinned host memory instead of
+                # being recomputed in the backward.
+                save_name="qkv_proj",
                 name="qkv",
             )(x)
             q = qkv[..., : self.head_dim]
@@ -215,6 +220,7 @@ class Attention(nn.Module):
                 use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                save_name="qkv_proj",
                 name="query",
             )(x)
             k = layers.DenseGeneral(
@@ -223,6 +229,7 @@ class Attention(nn.Module):
                 use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                save_name="qkv_proj",
                 name="key",
             )(x)
             v = layers.DenseGeneral(
@@ -231,6 +238,7 @@ class Attention(nn.Module):
                 use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                save_name="qkv_proj",
                 name="value",
             )(x)
 

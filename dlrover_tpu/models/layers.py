@@ -15,6 +15,7 @@ from typing import Any, Callable, Iterable, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 import numpy as np
 
@@ -56,6 +57,10 @@ class DenseGeneral(nn.Module):
     # the wo matmul, PROFILE.md round 4).  kernel_axes follow the STORED
     # order.  Checkpoint-format change where enabled.
     transpose_kernel: bool = False
+    # Tag the output as a named remat saveable
+    # (jax.ad_checkpoint.checkpoint_name) so ops/remat_policy.py policies
+    # can save or host-offload it individually.
+    save_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -99,6 +104,8 @@ class DenseGeneral(nn.Module):
                 self.param_dtype,
             )
             out = out + bias.astype(self.dtype)
+        if self.save_name:
+            out = jax.ad_checkpoint.checkpoint_name(out, self.save_name)
         return out
 
 

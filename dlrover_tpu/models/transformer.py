@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from dlrover_tpu.models import layers
 from dlrover_tpu.models.attention import Attention
 from dlrover_tpu.models.moe import MoEMlp
+from dlrover_tpu.ops import remat_policy as remat_policies
 from dlrover_tpu.ops.layout_pin import pin_layout
 from dlrover_tpu.parallel import rules as lr
 
@@ -76,10 +77,12 @@ class TransformerConfig:
     # 6.4 ms/layer LN-bwd sink.  Numerics-tested; on-chip speedup
     # unmeasured as of r5 (relay down) — off until a trace prices it.
     fused_ln: bool = False
-    remat: str = "none"            # one of _REMAT_POLICIES below: "none",
-                                   # "dots", "dots_no_batch", "full",
-                                   # "attn_out", "branch_out", "flash_res",
-                                   # "flash_only" (last two: flash impl only)
+    remat: str = "none"            # a registered ops/remat_policy.py name
+                                   # ("none", "dots", "dots_no_batch",
+                                   # "full", "attn_out", "branch_out",
+                                   # "flash_res", "flash_only" — flash impl
+                                   # only — "offload") or a selective
+                                   # "offload:<name>[,<name>...]" list
     scan_layers: bool = True
     scan_unroll: int = 1           # layers per scan iteration (XLA overlap)
     logits_dtype: Any = jnp.float32
@@ -119,22 +122,13 @@ class TransformerConfig:
                 f"attention_impl must be 'xla', 'flash' or 'ring', got "
                 f"{self.attention_impl!r}"
             )
-        if self.remat not in _REMAT_POLICIES:
-            raise ValueError(
-                f"remat must be one of {sorted(_REMAT_POLICIES)}, got "
-                f"{self.remat!r}"
-            )
-        if self.remat in ("flash_only", "flash_res") and (
-            self.attention_impl != "flash"
-        ):
-            # The flash_out/flash_lse names only exist inside the flash
-            # kernel's custom_vjp: under any other impl these policies would
-            # silently save nothing (= remat "full") and the HFU accounting
-            # keyed on the remat string would be wrong.
-            raise ValueError(
-                f"remat={self.remat!r} requires attention_impl='flash', got "
-                f"{self.attention_impl!r}"
-            )
+        # Registry-backed validation (ops/remat_policy.py): unknown names
+        # and flash-name policies under a non-flash impl both raise here —
+        # the flash_out/flash_lse names only exist inside the flash
+        # kernel's custom_vjp, so elsewhere those policies would silently
+        # save nothing (= remat "full") and the HFU accounting keyed on
+        # the remat string would be wrong.
+        remat_policies.validate(self.remat, self.attention_impl)
         if self.decode:
             if self.attention_impl != "xla":
                 raise ValueError(
@@ -235,6 +229,10 @@ class Mlp(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             transpose_kernel=self.wo_transposed,
+            # Remat saveable: offload-family policies park the wo output
+            # in pinned host memory so the backward skips the d_ff-wide
+            # recompute chain (wi (+wg) + activation + wo).
+            save_name="mlp_wo",
             name="wo",
         )(h)
 
@@ -314,38 +312,6 @@ class Block(nn.Module):
         return (x, aux), None
 
 
-_REMAT_POLICIES = {
-    "none": None,
-    "full": jax.checkpoint_policies.nothing_saveable,
-    # save matmul outputs, recompute elementwise (good HBM/FLOP tradeoff)
-    "dots": jax.checkpoint_policies.checkpoint_dots,
-    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-    # save only the attention block output (cheap in HBM, skips the most
-    # expensive recompute); everything else rematerializes
-    "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
-    # save both residual-branch outputs: backward skips the attention AND
-    # the wo-matmul recompute for reconstructing the residual stream
-    "branch_out": jax.checkpoint_policies.save_only_these_names(
-        "attn_out", "mlp_out"
-    ),
-    # attn_out + the flash kernel's own outputs (o, lse — named inside the
-    # custom_vjp fwd rule, ops/flash_attention.py): the backward replay
-    # DCEs the attention forward recompute entirely and feeds the saved
-    # residuals straight into the dq/dkv kernels.  Costs one extra
-    # b*s*h*hd bf16 tensor (+small lse) per layer over "attn_out".
-    "flash_res": jax.checkpoint_policies.save_only_these_names(
-        "attn_out", "flash_out", "flash_lse"
-    ),
-    # flash kernel residuals only: backward recomputes the (cheap)
-    # out-projection from the saved kernel output instead of saving the
-    # post-projection activation too — lowest-HBM way to skip the
-    # attention-forward recompute.
-    "flash_only": jax.checkpoint_policies.save_only_these_names(
-        "flash_out", "flash_lse"
-    ),
-}
-
-
 class TransformerLM(nn.Module):
     """Decoder-only LM.  ``__call__(tokens) -> (logits, aux_loss)``."""
 
@@ -390,7 +356,9 @@ class TransformerLM(nn.Module):
         x = nn.with_logical_constraint(x, (lr.BATCH, lr.ACT_SEQ, lr.ACT_EMBED))
 
         block_cls = Block
-        policy = _REMAT_POLICIES[cfg.remat]
+        # Registry lookup (ops/remat_policy.py): named save/offload sets,
+        # builtins, and the pinned-host fallback all resolve here.
+        policy = remat_policies.jax_policy(cfg.remat)
         if cfg.remat != "none":
             block_cls = nn.remat(
                 Block,

@@ -53,7 +53,12 @@ def quantized_all_reduce(
     result is identical on every member (quantization error included), so
     replicated-parameter invariants hold.
     """
-    n = jax.lax.axis_size(axis_name)
+    if hasattr(jax.lax, "axis_size"):
+        n = jax.lax.axis_size(axis_name)
+    else:  # older jax: the mesh axis size is a trace-time constant
+        n = jax.core.get_axis_env().axis_size(axis_name) if hasattr(
+            jax.core, "get_axis_env"
+        ) else int(jax.lax.psum(1, axis_name))
     if n == 1:
         return x
     orig_shape, orig_dtype = x.shape, x.dtype

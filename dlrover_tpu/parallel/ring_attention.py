@@ -33,6 +33,8 @@ from dlrover_tpu.runtime.mesh import (
     FSDP_AXIS,
     SEQ_AXIS,
     TENSOR_AXIS,
+    current_mesh,
+    shard_map_compat,
 )
 
 NEG_INF = -1e30
@@ -156,9 +158,9 @@ def ring_attention(
         causal=causal,
         scale=scale,
     )
-    return jax.shard_map(
+    return shard_map_compat(
         fn,
+        mesh=current_mesh(),
         in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
         out_specs=qkv_spec,
-        check_vma=False,
     )(q, k, v, segment_ids.astype(jnp.int32))

@@ -27,7 +27,11 @@ import jax.numpy as jnp
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 from dlrover_tpu.parallel import rules as lr
-from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.runtime.mesh import (
+    ParallelConfig,
+    activate_mesh,
+    build_mesh,
+)
 
 
 class EnginePhase(Enum):
@@ -88,7 +92,7 @@ class RLHFEngine:
             def _init(rng, module=module):
                 return module.init(rng, dummy)["params"]
 
-            with jax.set_mesh(mesh), nn.logical_axis_rules(self.rules):
+            with activate_mesh(mesh), nn.logical_axis_rules(self.rules):
                 abstract = jax.eval_shape(_init, jax.random.PRNGKey(0))
                 specs = nn.get_partition_spec(abstract)
                 shardings = nn.logical_to_mesh_sharding(
@@ -157,7 +161,7 @@ class RLHFEngine:
             logits, _ = module.apply({"params": params}, tokens)
             return token_logprobs(logits, tokens)
 
-        with jax.set_mesh(ctx["mesh"]):
+        with activate_mesh(ctx["mesh"]):
             return jax.jit(fn, in_shardings=(ctx["shardings"], None))
 
     def value_fn(self, role: str) -> Callable:
@@ -167,5 +171,5 @@ class RLHFEngine:
         def fn(params, tokens):
             return module.apply({"params": params}, tokens)
 
-        with jax.set_mesh(ctx["mesh"]):
+        with activate_mesh(ctx["mesh"]):
             return jax.jit(fn, in_shardings=(ctx["shardings"], None))

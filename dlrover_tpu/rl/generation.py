@@ -63,13 +63,28 @@ class GenerationBackend:
                 f"max_new_tokens {total} must leave room for a prompt "
                 f"inside max_seq_len {self.config.max_seq_len}"
             )
+        if self.sampling.top_k < 0:
+            raise ValueError(
+                f"top_k must be >= 0, got {self.sampling.top_k}"
+            )
+        if self.sampling.top_k > self.config.vocab_size:
+            # The kth-largest index would wrap around the sorted axis and
+            # the filter threshold becomes garbage — fail loudly instead.
+            raise ValueError(
+                f"top_k {self.sampling.top_k} exceeds vocab_size "
+                f"{self.config.vocab_size}"
+            )
         self._generate = jax.jit(self._generate_impl)
 
     def _sample(self, logits: jax.Array, rng: jax.Array) -> jax.Array:
         s = self.sampling
-        scaled = logits.astype(jnp.float32) / jnp.maximum(
-            s.temperature, 1e-6
-        )
+        logits32 = logits.astype(jnp.float32)
+        if s.temperature == 0.0:
+            # The temperature->0 limit is greedy argmax, not "divide by
+            # epsilon" (categorical over a numerically saturated
+            # distribution can still flip tokens on ties/rounding).
+            return jnp.argmax(logits32, axis=-1)
+        scaled = logits32 / jnp.maximum(s.temperature, 1e-6)
         if s.top_k:
             kth = jnp.sort(scaled, axis=-1)[..., -s.top_k][..., None]
             scaled = jnp.where(scaled >= kth, scaled, -1e15)

@@ -127,6 +127,16 @@ class PPOTrainer:
     ):
         self.config = config
         self.model_config = model_config
+        if config.use_kv_cache and model_config.pipeline_stages > 1:
+            # The decode-mode model behind GenerationBackend is a plain
+            # layer scan (pipeline_stages=1 by construction): its param
+            # tree cannot host PipelinedBlocks params, so rollouts would
+            # fail at apply time with a shape error deep in flax.
+            raise ValueError(
+                "use_kv_cache=True requires pipeline_stages == 1 (got "
+                f"{model_config.pipeline_stages}); set use_kv_cache=False "
+                "for pipelined configs (full-reforward sampler)"
+            )
         if reward_fn is None:
             # A learned reward MODEL (ref ``atorch/rl`` reward/cost model
             # keys): the engine's "reward" role (critic-shaped scalar

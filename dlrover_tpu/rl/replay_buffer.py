@@ -56,11 +56,15 @@ class ReplayBuffer:
         with self._mu:
             if not self._rows:
                 raise ValueError("empty replay buffer")
-            replace = len(self._rows) < batch_size
+            # Snapshot to a list: indexing a deque is O(distance from an
+            # end), so gathering a random batch straight off it is
+            # O(n * batch); one O(n) copy then O(1) row lookups.
+            rows_all = list(self._rows)
+            replace = len(rows_all) < batch_size
             idx = self._rng.choice(
-                len(self._rows), size=batch_size, replace=replace
+                len(rows_all), size=batch_size, replace=replace
             )
-            rows = [self._rows[i] for i in idx]
+        rows = [rows_all[i] for i in idx]
         return {
             k: np.stack([r[k] for r in rows]) for k in rows[0]
         }

@@ -210,15 +210,18 @@ def _run_master_only(args) -> int:
     logger.info("cluster master on port %d (cloud=%s)", port, args.cloud)
     if launcher is not None:
         master.bootstrap_nodes()
+    terminal = False
     try:
         while True:
             phase = master.job_phase()
             if phase == "failed":
+                terminal = True
                 logger.error(
                     "job failed: %s", master.node_manager.job_failure_reason
                 )
                 return 1
             if phase == "succeeded":
+                terminal = True
                 logger.info("job succeeded")
                 return 0
             time.sleep(2.0)
@@ -226,9 +229,19 @@ def _run_master_only(args) -> int:
         return 130
     finally:
         if launcher is not None:
-            # Operator teardown: a finished cloud job must not leave
-            # billing VMs behind.
-            master.teardown_nodes()
+            if terminal:
+                # Operator teardown: a finished cloud job must not leave
+                # billing VMs behind.
+                master.teardown_nodes()
+            else:
+                # Ctrl-C / master crash mid-job: leave the nodes (and the
+                # job they are running) up so a restarted master can
+                # reattach via state_path instead of finding a torn-down
+                # slice.  Terminal phases above still clean up billing VMs.
+                logger.warning(
+                    "master exiting before the job finished; leaving "
+                    "nodes up for a reattaching master (state_path)"
+                )
         master.stop()
         if launcher is not None and hasattr(launcher, "shutdown"):
             launcher.shutdown()

@@ -174,12 +174,55 @@ def _smallest_prime_factor(n: int) -> int:
     return n
 
 
+def current_mesh():
+    """The ambient mesh, or None: the abstract mesh on jax >= 0.5
+    (``jax.set_mesh``), else the physical context mesh (``with mesh:``)
+    that older jax's thread resources track."""
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        mesh = get_abstract()
+        if mesh is not None and not mesh.empty:
+            return mesh
+    from jax._src import mesh as mesh_lib
+
+    physical = mesh_lib.thread_resources.env.physical_mesh
+    if physical is not None and not physical.empty:
+        return physical
+    return None
+
+
+def activate_mesh(mesh):
+    """Context manager making ``mesh`` ambient for tracing and execution:
+    ``jax.set_mesh`` where it exists, else the Mesh context manager (the
+    same scope on older jax)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with replication/vma checking off, tolerant of
+    the ``jax.experimental.shard_map`` era (``check_rep``) and the
+    top-level ``jax.shard_map`` era (``check_vma``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def mesh_axis_size(axis: str) -> int:
-    """Size of a named axis on the ambient (abstract) mesh; 1 when no mesh
-    is set or the axis is absent.  Model code gates explicit collectives
-    (Ulysses a2a, grouped-MoE dispatch) on this."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or mesh.empty:
+    """Size of a named axis on the ambient mesh; 1 when no mesh is set or
+    the axis is absent.  Model code gates explicit collectives (Ulysses
+    a2a, grouped-MoE dispatch) on this."""
+    mesh = current_mesh()
+    if mesh is None:
         return 1
     return dict(zip(mesh.axis_names, mesh.axis_sizes)).get(axis, 1)
 

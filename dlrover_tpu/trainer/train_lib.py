@@ -31,7 +31,9 @@ class TrainState(flax_train_state.TrainState):
 
 def use_mesh(mesh: Mesh):
     """Context entering the mesh for both tracing and execution."""
-    return jax.set_mesh(mesh)
+    from dlrover_tpu.runtime.mesh import activate_mesh
+
+    return activate_mesh(mesh)
 
 
 def make_schedule(
@@ -313,7 +315,7 @@ def build_sharded_train(
         params = nn.meta.unbox(model.init(rng, dummy_tokens)["params"])
         return _make_state(params, optimizer.init(params))
 
-    with jax.set_mesh(mesh), nn.logical_axis_rules(rules):
+    with use_mesh(mesh), nn.logical_axis_rules(rules):
         abstract_state = jax.eval_shape(_init_boxed, jax.random.PRNGKey(0))
         abstract_state = _sanitize_boxes(abstract_state)
         logical_specs = nn.get_partition_spec(abstract_state)

@@ -39,6 +39,10 @@ def parse_args():
                    help="test hook: crash at this step on first run")
     p.add_argument("--step-sleep", type=float, default=0.0,
                    help="test hook: slow steps down (chaos windows)")
+    p.add_argument("--remat", default="none",
+                   help="remat policy (ops/remat_policy.py): none, full, "
+                        "attn_out, branch_out, flash_only, flash_res, "
+                        "offload, offload:<name>[,<name>...]")
     p.add_argument("--auto-tune", action="store_true",
                    help="search mesh/remat strategy before training "
                         "(auto_accelerate equivalent)")
@@ -74,6 +78,7 @@ def main():
         num_heads=args.heads,
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
+        remat=args.remat,
     )
     trainer = ElasticTrainer(
         cfg,

@@ -369,3 +369,33 @@ def test_flash_save_gb_scale_is_subsecond():
         assert meta.step == 2
     finally:
         handler.close(unlink=True)
+
+
+def test_forced_stop_leaves_shared_resources_open(tmp_path):
+    """If the saver thread is wedged mid-persist past the forced-stop
+    window, stop() must NOT close the shared queue/lock/status/shm under
+    it — closing would corrupt the in-flight write or raise in the
+    worker.  Leak the handles; the process is exiting anyway."""
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    saver = AsyncCheckpointSaver(
+        str(tmp_path / "ckpt"), host_index=0, num_hosts=1
+    )
+    release = threading.Event()
+    stuck = threading.Thread(target=release.wait, daemon=True)
+    stuck.start()
+    saver._thread = stuck  # a worker wedged inside a persist
+    saver.DRAIN_TIMEOUT_S = 0.2  # instance attrs shadow the class windows
+    saver.FORCED_JOIN_TIMEOUT_S = 0.2
+
+    saver.stop()  # must return (leaking), not raise or hang
+
+    assert stuck.is_alive()
+    # The shared resources the "worker" may be holding are still usable.
+    saver._status.update({"probe": 1})
+    assert saver._status.get("probe") == 1
+    assert saver._event_queue.get(timeout=1.0) is not None  # the EXIT event
+    # Once the worker actually exits, a second stop() closes everything.
+    release.set()
+    stuck.join(timeout=5.0)
+    saver.stop()

@@ -10,6 +10,7 @@ from dlrover_tpu.data.loader import (
 )
 
 
+@pytest.mark.slow  # spawns worker subprocesses; stall-timeout bound when loaded
 def test_coworker_matches_inprocess_batches():
     """Worker-process preprocessing must produce byte-identical, in-order
     batches to calling sample_fn inline."""

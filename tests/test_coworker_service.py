@@ -116,6 +116,7 @@ def _serve_proc(port_q, n):
     loader.close()
 
 
+@pytest.mark.slow  # forks a coworker-host process that serves for ~8s
 def test_cross_process_host_with_shm_ring():
     """Full stack across a process boundary: coworker host process runs
     preprocessing workers + shm ring + server; this process consumes."""

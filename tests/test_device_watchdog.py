@@ -59,6 +59,7 @@ def _agent(master_port, script, **cfg_kwargs):
     )
 
 
+@pytest.mark.slow  # chaos test: hung-init restart cycles with real timeouts
 def test_hung_device_init_restarts_then_fails():
     master = JobMaster(num_nodes=1, heartbeat_timeout=3600.0)
     port = master.start()

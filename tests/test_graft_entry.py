@@ -8,12 +8,15 @@ import jax
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import __graft_entry__ as graft  # noqa: E402
+import pytest
 
 
+@pytest.mark.slow  # end-to-end driver dryrun over an 8-device virtual mesh
 def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # end-to-end driver dryrun over an 8-device virtual mesh
 def test_dryrun_multichip_2():
     graft.dryrun_multichip(2)
 

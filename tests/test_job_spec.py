@@ -520,3 +520,70 @@ def test_migration_rollback_leaves_no_orphan():
     assert sorted(nm.statuses(pool="coworker")) == [base]
     nm.report_event(0, "succeeded")
     assert nm.all_succeeded()
+
+
+def _master_only_fakes(phases):
+    """A fake (master, launcher) pair for _run_master_only: job_phase()
+    yields from ``phases`` (a KeyboardInterrupt instance raises)."""
+    calls = []
+    seq = iter(phases)
+
+    class FakeMaster:
+        node_manager = type("NM", (), {"job_failure_reason": "boom"})()
+
+        def start(self):
+            return 4711
+
+        def bootstrap_nodes(self):
+            calls.append("bootstrap")
+
+        def job_phase(self):
+            item = next(seq)
+            if isinstance(item, BaseException):
+                raise item
+            return item
+
+        def teardown_nodes(self):
+            calls.append("teardown")
+
+        def stop(self):
+            calls.append("stop")
+
+    class FakeLauncher:
+        def shutdown(self):
+            calls.append("shutdown")
+
+    return FakeMaster(), FakeLauncher(), calls
+
+
+@pytest.mark.parametrize("phases,rc,torn_down", [
+    (["succeeded"], 0, True),
+    (["failed"], 1, True),
+    ([KeyboardInterrupt()], 130, False),
+    ([RuntimeError("master crashed")], None, False),
+])
+def test_master_only_tears_down_only_on_terminal_phase(
+    monkeypatch, phases, rc, torn_down
+):
+    """Ctrl-C / a master crash mid-job must NOT delete the worker VMs —
+    a restarted master reattaches via state_path.  Only terminal job
+    phases (succeeded/failed) clean up billing VMs."""
+    import types
+
+    from dlrover_tpu import run as run_mod
+
+    master, launcher, calls = _master_only_fakes(phases)
+    monkeypatch.setattr(
+        run_mod, "build_cluster_master", lambda args: (master, launcher)
+    )
+    args = types.SimpleNamespace(cloud=True)
+    if rc is None:
+        with pytest.raises(RuntimeError, match="master crashed"):
+            run_mod._run_master_only(args)
+    else:
+        assert run_mod._run_master_only(args) == rc
+    assert ("teardown" in calls) == torn_down
+    # The master itself and the launcher session always shut down.
+    assert "stop" in calls and "shutdown" in calls
+    if torn_down:  # cleanup ordering: VMs before the master goes away
+        assert calls.index("teardown") < calls.index("stop")

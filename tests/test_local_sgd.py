@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from dlrover_tpu.parallel.local_sgd import (
     LocalSGD,
@@ -13,6 +14,7 @@ from dlrover_tpu.parallel.local_sgd import (
 )
 
 
+@pytest.mark.slow  # multi-step consensus loop, ~1 min on the 1-core CI box
 def test_gta_reduce_sign_consensus():
     deltas = [
         {"w": jnp.asarray([1.0, -1.0, 2.0])},

@@ -21,6 +21,7 @@ def test_quantize_dequantize_roundtrip(rng):
     assert err.max() <= bound + 1e-6
 
 
+@pytest.mark.slow  # long optimizer tracking loop
 def test_q8_adam_tracks_fp32_adam(rng):
     """Quantized Adam should follow full-precision Adam closely on a quadratic."""
     dim = 8192  # above min_quant_size -> quantized path
@@ -63,6 +64,7 @@ def test_q8_adam_small_leaf_exact(rng):
     np.testing.assert_allclose(u_q["b"], u_f["b"], atol=1e-6, rtol=1e-5)
 
 
+@pytest.mark.slow  # long optimizer tracking loop
 def test_q4_adam_tracks_fp32_adam(rng):
     """4-bit moments: coarser than q8 but must still descend comparably
     (ref low_bit/functional.py q4 states)."""

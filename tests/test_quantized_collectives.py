@@ -14,7 +14,11 @@ from dlrover_tpu.parallel.quantized_collectives import (
     _block_quant,
     quantized_all_reduce,
 )
-from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.runtime.mesh import (
+    ParallelConfig,
+    build_mesh,
+    shard_map_compat,
+)
 
 
 def test_block_quant_roundtrip_error_bound():
@@ -39,9 +43,8 @@ def test_quantized_all_reduce_matches_psum_mean():
     x = jnp.asarray(rng.normal(size=(4, 700)), jnp.float32)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map_compat, mesh=mesh,
         in_specs=P("data", None), out_specs=P("data", None),
-        check_vma=False,
     )
     def reduce(block):
         out = quantized_all_reduce(block[0], "data", block=256)
@@ -63,8 +66,7 @@ def test_quantized_all_reduce_single_member_is_identity():
     x = jnp.arange(512.0)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
-        check_vma=False,
+        shard_map_compat, mesh=mesh, in_specs=P(), out_specs=P(),
     )
     def reduce(v):
         return quantized_all_reduce(v, "data", block=256)

@@ -9,7 +9,11 @@ import pytest
 
 from dlrover_tpu.models.attention import xla_attention
 from dlrover_tpu.parallel.ring_attention import ring_attention
-from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.runtime.mesh import (
+    ParallelConfig,
+    activate_mesh,
+    build_mesh,
+)
 
 
 @pytest.fixture()
@@ -23,7 +27,7 @@ def test_ring_matches_reference(rng, seq4_mesh, causal):
     q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
     k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
-    with jax.set_mesh(seq4_mesh):
+    with activate_mesh(seq4_mesh):
         out = jax.jit(
             functools.partial(ring_attention, causal=causal)
         )(q, k, v)
@@ -37,7 +41,7 @@ def test_ring_segments_and_gqa(rng, seq4_mesh):
     k = jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32)
     seg = jnp.asarray((np.arange(s) // 16)[None].repeat(b, 0), jnp.int32)
-    with jax.set_mesh(seq4_mesh):
+    with activate_mesh(seq4_mesh):
         out = jax.jit(
             functools.partial(ring_attention, causal=True)
         )(q, k, v, segment_ids=seg)
@@ -51,7 +55,7 @@ def test_ring_grads(rng, seq4_mesh):
     k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
 
-    with jax.set_mesh(seq4_mesh):
+    with activate_mesh(seq4_mesh):
         g_ring = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(ring_attention(q, k, v, causal=True) ** 2),
             argnums=(0, 1, 2),

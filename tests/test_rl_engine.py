@@ -211,6 +211,7 @@ def test_engine_places_roles_on_distinct_meshes():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # full RLHF engine e2e
 def test_ppo_e2e_with_engine_generation_and_replay():
     """The whole engine: KV-cache rollouts, per-role meshes (actor
     tensor-sharded, critic data-parallel), replay minibatching — reward
@@ -250,6 +251,7 @@ def test_ppo_e2e_with_engine_generation_and_replay():
     assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.05, rewards
 
 
+@pytest.mark.slow  # full RLHF engine e2e
 def test_reward_model_role_replaces_reward_fn():
     """reward_fn=None: the engine's 'reward' role (a learned reward
     model) scores rollouts — the reference's reward-model key

@@ -15,6 +15,7 @@ from dlrover_tpu.agent.stack_collector import (
 )
 
 
+@pytest.mark.slow  # spawns a python subprocess and polls it for seconds
 def test_collect_stacks_from_live_process(tmp_path):
     path = str(tmp_path / "stacks.txt")
     child = subprocess.Popen(

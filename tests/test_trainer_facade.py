@@ -81,6 +81,7 @@ class Recorder(TrainerCallback):
         self.events.append(("end", step))
 
 
+@pytest.mark.slow  # multi-epoch fit loop e2e
 def test_fit_with_callbacks_eval_and_lr():
     trainer = _tiny_trainer(
         warmup_steps=4, decay_steps=20, eval_every=3, eval_batches=2,
@@ -105,6 +106,7 @@ def test_fit_with_callbacks_eval_and_lr():
     assert trainer.current_lr() > lr_start
 
 
+@pytest.mark.slow  # multi-epoch fit loop e2e
 def test_fit_epochs_and_resume_accounting(tmp_path):
     trainer = _tiny_trainer(tmp_path=tmp_path)
     recorder = Recorder()

@@ -108,6 +108,12 @@ class Candidate:
     # reading the candidate table) can see WHY a policy won.
     est_recompute_time: float = 0.0
     est_dma_time: float = 0.0
+    # Input-pipeline H2D time for the local batch slice.  With the device
+    # prefetcher (data.loader.DevicePrefetcher) this OVERLAPS compute, so
+    # it enters the step estimate under the same max() as compute/HBM
+    # rather than as an additive term — exposed so the candidate table
+    # shows when a shape is input-bound (t_h2d is the max).
+    est_h2d_time: float = 0.0
     measured_step_time: Optional[float] = None
     measured_tokens_per_sec: Optional[float] = None
     rejected: str = ""
@@ -452,10 +458,18 @@ def _estimate(
         if rows_per_micro < 1:
             cand.rejected = f"microbatches {micro} > local batch rows"
             return
+    # H2D input placement: int32 inputs + targets (4 B each) and fp32
+    # per-row weights amortized per token — ~12 B/token crossing the host
+    # DMA link for the local slice.  The device prefetcher overlaps this
+    # copy with the previous step's compute, so it shares the roofline
+    # max() with compute/HBM instead of adding to the critical path; a
+    # shape is only penalized when it is genuinely input-bound.
+    t_h2d = tokens_local * 12 / host_dma_bandwidth()
     cand.est_recompute_time = t_recompute
     cand.est_dma_time = t_dma
+    cand.est_h2d_time = t_h2d
     cand.est_step_time = (
-        max(t_compute, t_hbm) + t_recompute + t_dma + t_ici
+        max(t_compute, t_hbm, t_h2d) + t_recompute + t_dma + t_ici
     ) * bubble
 
 

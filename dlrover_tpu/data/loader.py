@@ -13,6 +13,7 @@ requeue automatically).
 
 from __future__ import annotations
 
+import collections
 import threading
 import queue as _queue
 from typing import Callable, Dict, Iterator, List, Optional
@@ -95,6 +96,10 @@ class ElasticDataLoader:
         self.source = source
         self.prefetch = prefetch
         self.drop_last = drop_last
+        # Generation token: bumped by every fresh iteration so a producer
+        # thread that outlived its iterator (join timeout) can never keep
+        # consuming the shared source on behalf of a successor iterator.
+        self._generation = 0
 
     def _indexed_stream(self) -> Iterator:
         """Yields (index, completed_shards) — shards listed once all their
@@ -141,28 +146,27 @@ class ElasticDataLoader:
         for shard in shards:
             self.source.report_shard_done(shard)
 
-    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        """Shard-ack contract: a shard is acked only once the consumer has
-        come back for the batch *after* the one that finished it — i.e. the
-        covering batch was actually handed to (and presumably trained by)
-        the caller, not merely prefetched.  A crash mid-batch leaves its
-        shards unacked, so the master requeues them (at-least-once)."""
-        if self.prefetch <= 0:
-            pending: List = []
-            for batch, done in self._batches():
-                self._ack(pending)
-                pending = done
-                yield batch
-            self._ack(pending)
-            return
+    def _threaded_items(self) -> Iterator:
+        """(batch, done_shards) pairs produced on a background thread.
 
+        The producer captures this iteration's generation token; a stale
+        producer (its consumer timed out the join and moved on) fails the
+        ``live()`` check on its next queue interaction and exits — it can
+        never enqueue into, or keep consuming the shared source for, a
+        successor iterator.
+        """
+        self._generation += 1
+        gen = self._generation
         q: _queue.Queue = _queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         stop = threading.Event()
         error: List[BaseException] = []
 
+        def live() -> bool:
+            return not stop.is_set() and gen == self._generation
+
         def put_retrying(item) -> bool:
-            while not stop.is_set():
+            while live():
                 try:
                     q.put(item, timeout=0.2)
                     return True
@@ -184,19 +188,14 @@ class ElasticDataLoader:
 
         thread = threading.Thread(target=produce, daemon=True)
         thread.start()
-        pending = []
         try:
             while True:
                 item = q.get()
                 if item is sentinel:
                     if error:
                         raise error[0]
-                    self._ack(pending)
                     return
-                batch, done = item
-                self._ack(pending)
-                pending = done
-                yield batch
+                yield item
         finally:
             # Consumer abandoned the iterator (break) or finished: stop the
             # producer so it doesn't park in q.put forever. Unacked shards
@@ -208,6 +207,105 @@ class ElasticDataLoader:
                 except _queue.Empty:
                     break
             thread.join(timeout=2.0)
+            if thread.is_alive():
+                logger.warning(
+                    "loader producer thread (generation %d) outlived its "
+                    "2s join; the generation token bars it from later "
+                    "iterations, but it may still hold a source fetch",
+                    gen,
+                )
+
+    def _items(self) -> Iterator:
+        if self.prefetch <= 0:
+            yield from self._batches()
+        else:
+            yield from self._threaded_items()
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Shard-ack contract: a shard is acked only once the consumer has
+        come back for the batch *after* the one that finished it — i.e. the
+        covering batch was actually handed to (and presumably trained by)
+        the caller, not merely prefetched.  A crash mid-batch leaves its
+        shards unacked, so the master requeues them (at-least-once)."""
+        pending: List = []
+        for batch, done in self._items():
+            self._ack(pending)
+            pending = done
+            yield batch
+        self._ack(pending)
+
+    def batches_with_acks(self) -> Iterator:
+        """(batch, ack) pairs for consumers that know when a batch was
+        *actually* trained — ``ack()`` marks the shards the batch finished.
+
+        The device prefetcher needs this split: with N batches resident on
+        device ahead of compute, "the consumer came back for the next
+        batch" (the ``__iter__`` contract) would fire N batches early and a
+        crash would silently skip device-buffered-but-untrained shards.
+        An abandoned iterator leaves un-acked shards to the master's
+        timeout requeue, exactly like ``__iter__``.
+        """
+        for batch, done in self._items():
+            yield batch, (lambda shards=tuple(done): self._ack(shards))
+
+
+class DevicePrefetcher:
+    """Double-buffers device placement so H2D overlaps device compute.
+
+    Wraps a host-batch iterable and keeps up to ``depth`` batches resident
+    on device ahead of the consumer: before batch N is handed out, the
+    ``place_fn`` (typically ``train_lib.shard_batch`` — an async
+    ``jax.device_put`` under the hood) has already been issued for batches
+    N+1..N+depth, so their H2D transfer rides under step N's compute.
+
+    Ack semantics: when the source exposes ``batches_with_acks`` (the
+    elastic loader), each batch's ack fires only after the consumer comes
+    back for the NEXT batch — i.e. the batch was actually consumed, not
+    merely device-buffered.  A crash mid-pipeline leaves the in-flight and
+    buffered batches unacked for the master to requeue.
+
+    Re-iterable when the source is (each ``__iter__`` opens a fresh pass).
+    """
+
+    def __init__(self, source, place_fn: Callable, depth: int = 2):
+        self.source = source
+        self.place_fn = place_fn
+        self.depth = max(1, depth)
+
+    def _pairs(self) -> Iterator:
+        if hasattr(self.source, "batches_with_acks"):
+            yield from self.source.batches_with_acks()
+        else:
+            for batch in self.source:
+                yield batch, None
+
+    def __iter__(self) -> Iterator:
+        it = self._pairs()
+        buf: collections.deque = collections.deque()
+
+        def top_up():
+            while len(buf) < self.depth:
+                try:
+                    batch, ack = next(it)
+                except StopIteration:
+                    return
+                buf.append((self.place_fn(batch), ack))
+
+        try:
+            top_up()
+            while buf:
+                placed, ack = buf.popleft()
+                # Place N+1..N+depth BEFORE handing out N: the overlap
+                # contract the pipeline tests assert.
+                top_up()
+                yield placed
+                # The consumer came back: batch was consumed, not merely
+                # buffered — safe to ack its shards now.
+                if ack is not None:
+                    ack()
+        finally:
+            if hasattr(it, "close"):
+                it.close()
 
 
 def _collate(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

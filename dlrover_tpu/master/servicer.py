@@ -10,6 +10,7 @@ dispatch entry, no codegen step.
 
 from __future__ import annotations
 
+import json
 import pickle
 from concurrent import futures
 from typing import Any, Callable, Dict, Optional, Type
@@ -208,6 +209,22 @@ class MasterServicer:
 
     def _report_event(self, env: msg.Envelope):
         p: msg.NodeEventReport = env.payload
+        if p.event == "compile" and self.speed_monitor is not None:
+            # Trainer (re)compile wall time → the goodput compile ledger.
+            # Detail is trainer-authored JSON; a malformed report must not
+            # fail the RPC (the node event below still lands).
+            try:
+                detail = json.loads(p.detail or "{}")
+                self.speed_monitor.record_compile(
+                    float(detail.get("seconds", 0.0)),
+                    restart=bool(detail.get("restart", False)),
+                    cached=bool(detail.get("cached", False)),
+                )
+            except (ValueError, TypeError):
+                logger.warning(
+                    "unparseable compile event from %s: %r",
+                    p.node_id, p.detail,
+                )
         if self.node_manager:
             self.node_manager.report_event(p.node_id, p.event, p.detail)
 

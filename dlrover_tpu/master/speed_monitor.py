@@ -30,6 +30,15 @@ class SpeedMonitor:
         self._first_step_time: Optional[float] = None
         # (ts, step, encoded) numeric anomalies from trainers.
         self._anomalies: Deque[Tuple[float, int, str]] = deque(maxlen=256)
+        # Compile-time ledger: first-start compiles are the price of
+        # admission; RESTART compiles are pure goodput loss the persistent
+        # compilation cache exists to erase — booked separately so the
+        # ledger shows the cache working (restart_compile_s → 0).
+        self._compile_s = 0.0
+        self._restart_compile_s = 0.0
+        self._compile_events = 0
+        self._restart_compiles = 0
+        self._cached_compiles = 0
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -62,6 +71,29 @@ class SpeedMonitor:
         cutoff = time.time() - window_s
         with self._lock:
             return [a for a in self._anomalies if a[0] >= cutoff]
+
+    def record_compile(
+        self, seconds: float, restart: bool = False, cached: bool = False
+    ):
+        """A trainer's (re)compile wall time, from its "compile" event."""
+        with self._lock:
+            self._compile_events += 1
+            self._compile_s += seconds
+            if restart:
+                self._restart_compiles += 1
+                self._restart_compile_s += seconds
+            if cached:
+                self._cached_compiles += 1
+
+    def compile_ledger(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "compile_s": self._compile_s,
+                "restart_compile_s": self._restart_compile_s,
+                "compile_events": self._compile_events,
+                "restart_compiles": self._restart_compiles,
+                "cached_compiles": self._cached_compiles,
+            }
 
     def reset_running_speed(self):
         """Call on restart: the gap until the next step report is downtime."""

@@ -1,0 +1,104 @@
+"""Restart-fast compile: persistent XLA cache + in-process program reuse.
+
+Every elastic restart pays retrace + compile for a program that is, by
+construction, identical to the one the previous world ran whenever the
+(config, mesh-shape) pair is unchanged — the dominant goodput tax the
+Flash-Checkpoint story leaves on the table.  Two layers remove it:
+
+1. **Persistent XLA compilation cache** (cross-process): ``enable()``
+   points ``jax.config.jax_compilation_cache_dir`` at a directory keyed
+   under the job workdir, so a restarted process re-traces but skips the
+   XLA compile.  Knob: ``DLROVER_TPU_COMPILE_CACHE`` (or an explicit
+   checkpoint-workdir-derived path).
+2. **In-process ShardedTrain memo** (same-process restarts — e.g. a
+   trainer rebuilt after a resize back to a previously-seen mesh shape):
+   ``train_cache_key`` names the compiled program by everything that
+   shapes it; ``trainer.train_lib.build_sharded_train`` memoizes on it so
+   the second construction performs ZERO retraces.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+# Env knob: set to a directory to enable the persistent XLA compile cache
+# for every trainer in the job (the agent exports it to workers so a
+# restarted worker lands on the same cache).
+ENV_COMPILE_CACHE = "DLROVER_TPU_COMPILE_CACHE"
+
+_enabled_dir: Optional[str] = None
+
+
+def cache_dir_for(workdir: str) -> str:
+    """The compile-cache directory keyed under a job workdir."""
+    return os.path.join(workdir, "compile_cache")
+
+
+def enable(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; thresholds are dropped to zero so even the small CPU-mesh
+    test programs populate the cache (the default min-compile-time gate
+    would skip them and hide cache bugs until a real TPU run).
+    """
+    global _enabled_dir
+    cache_dir = os.path.abspath(cache_dir)
+    if _enabled_dir == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    _enabled_dir = cache_dir
+    logger.info("persistent compilation cache enabled at %s", cache_dir)
+    return cache_dir
+
+
+def enabled_dir() -> Optional[str]:
+    return _enabled_dir
+
+
+def maybe_enable(explicit_dir: str = "", workdir: str = "") -> Optional[str]:
+    """Resolve + enable the cache dir: explicit > env knob > workdir-derived.
+
+    Returns the enabled directory, or None when no source names one (the
+    cache stays off — tests and ad-hoc runs must not write to CWD).
+    """
+    cache_dir = (
+        explicit_dir
+        or os.environ.get(ENV_COMPILE_CACHE, "")
+        or (cache_dir_for(workdir) if workdir else "")
+    )
+    if not cache_dir:
+        return None
+    return enable(cache_dir)
+
+
+def train_cache_key(
+    model_config,
+    mesh_shape,
+    *,
+    global_batch_size: int,
+    seq_len: int,
+    ce_chunks: int = 0,
+    optimizer: str = "",
+) -> str:
+    """Name the compiled train program by everything that shapes it.
+
+    Two trainers with equal keys compile byte-identical programs: the
+    model config dataclass fields, the mesh axis sizes (shape, not device
+    objects — a restart's fresh Mesh over the same devices must hit), the
+    batch geometry, and the optimizer recipe.
+    """
+    fields = tuple(sorted(
+        (k, repr(v)) for k, v in vars(model_config).items()
+    ))
+    return repr((
+        type(model_config).__name__, fields, tuple(mesh_shape),
+        global_batch_size, seq_len, ce_chunks, optimizer,
+    ))

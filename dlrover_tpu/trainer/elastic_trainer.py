@@ -19,8 +19,9 @@ telemetry, and the crash/elastic-resume contract.
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
-from typing import Any, Callable, Dict, Iterable, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -29,9 +30,10 @@ import optax
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 from dlrover_tpu.parallel import rules as lr
-from dlrover_tpu.runtime import env as renv
+from dlrover_tpu.runtime import compile_cache, env as renv
 from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
 from dlrover_tpu.trainer import train_lib
+from dlrover_tpu.utils.profiler import pipeline_counters
 
 
 @dataclasses.dataclass
@@ -55,6 +57,26 @@ class TrainerConfig:
     # Numeric health (trainer/numeric_health.py): anomalies ship to the
     # master with step reports, feeding the NumericAnomalyOperator.
     numeric_checks: bool = True
+    # -- async step pipeline ------------------------------------------------
+    # Deferred metrics: keep step metrics on device in a ring and
+    # materialize them this many steps later with ONE blocking fetch
+    # (flushed early at eval/checkpoint/end-of-fit).  0 = synchronous
+    # legacy behavior: every report blocks on float(loss).
+    metrics_lag: int = 0
+    # Keep this many batches device-resident ahead of compute so the H2D
+    # device_put of batch N+1 overlaps step N (data.loader.DevicePrefetcher).
+    # 0 = place each batch synchronously on the step's critical path.
+    prefetch_to_device: int = 0
+    # -- restart-fast compile ----------------------------------------------
+    # Reuse in-process compiled programs when (config, mesh-shape) repeats
+    # (train_lib build cache keyed by compile_cache.train_cache_key).
+    reuse_compiled: bool = True
+    # AOT lower().compile() the step at construction and report the wall
+    # time to the master's goodput ledger (event "compile").
+    warmup_compile: bool = False
+    # Persistent XLA compilation cache directory; "" resolves the
+    # DLROVER_TPU_COMPILE_CACHE env knob, then checkpoint_dir/compile_cache.
+    compile_cache_dir: str = ""
 
 
 class TrainerCallback:
@@ -145,13 +167,52 @@ class ElasticTrainer:
         # checkpoint into a fresh trainer.
         self._state_poisoned = False
         self._last_metrics = None
+        # Deferred-metrics ring: (step, device_metrics) pairs awaiting the
+        # single batched fetch in _flush_metrics.
+        self._metrics_ring: List[Tuple[int, Dict[str, Any]]] = []
+        self._on_step: Optional[Callable[[int, Dict], None]] = None
+        self._fit_max_steps = 0
+        # Restart-fast compile, layer 1: persistent XLA cache so a restarted
+        # process re-traces but skips compilation.
+        compile_cache.maybe_enable(
+            config.compile_cache_dir, workdir=config.checkpoint_dir
+        )
+        # Layer 2: in-process program reuse.  Only config-built pieces are
+        # representable in the key — a caller-supplied optimizer or rule
+        # set could close over anything, so either one opts out.
+        cache_key = None
+        if config.reuse_compiled and optimizer is None and rules is None:
+            cache_key = compile_cache.train_cache_key(
+                model_config, self.mesh.devices.shape,
+                global_batch_size=config.global_batch_size,
+                seq_len=config.seq_len,
+                ce_chunks=config.ce_chunks,
+                optimizer=(
+                    f"{config.optimizer}/lr={config.learning_rate!r}"
+                    f"/warmup={config.warmup_steps}"
+                    f"/decay={config.decay_steps}"
+                ),
+            )
         self.train = train_lib.build_sharded_train(
             self.model, self.optimizer, self.mesh,
             rules if rules is not None else lr.DEFAULT_RULES,
             global_batch_size=config.global_batch_size,
             seq_len=config.seq_len,
             ce_chunks=config.ce_chunks,
+            cache_key=cache_key,
         )
+        if config.warmup_compile:
+            compile_s = self.train.aot_compile()
+            # 0.0 means the build cache handed back an already-compiled
+            # program — a zero-cost restart, recorded as a cache hit.
+            detail = {
+                "seconds": round(compile_s, 6),
+                "restart": renv.restart_count() > 0,
+                "cached": compile_s == 0.0,
+            }
+            logger.info("compile warmup: %s", detail)
+            if self.client is not None:
+                self.client.report_event("compile", json.dumps(detail))
         self.state = self.train.init(jax.random.PRNGKey(0))
         self.step = 0
         self._last_saved = 0
@@ -183,10 +244,56 @@ class ElasticTrainer:
 
     def train_step(self, batch: Dict[str, Any]):
         placed = train_lib.shard_batch(batch, self.train)
+        t0 = time.perf_counter()
         self.state, metrics = self.train.step(self.state, placed)
         self.step += 1
+        pipeline_counters().record_dispatch(
+            self.step, time.perf_counter() - t0
+        )
         self._last_metrics = metrics
         return metrics
+
+    def _batch_stream(self, loader: Iterable) -> Iterable:
+        """Wrap ``loader`` in a DevicePrefetcher when configured, so batch
+        N+1's H2D placement is issued before batch N is even handed to
+        ``train_step`` (whose ``shard_batch`` then passes it through)."""
+        if self.config.prefetch_to_device <= 0:
+            return loader
+        from dlrover_tpu.data.loader import DevicePrefetcher
+
+        return DevicePrefetcher(
+            loader,
+            lambda batch: train_lib.shard_batch(batch, self.train),
+            depth=self.config.prefetch_to_device,
+        )
+
+    # -- deferred metrics ------------------------------------------------------
+
+    def _flush_metrics(self):
+        """Materialize the deferred-metrics ring with ONE blocking sync.
+
+        Called every ``metrics_lag`` steps by the fit loop and forced at
+        the pipeline barriers — evaluate, checkpoint, end-of-fit (a resize
+        restart tears the trainer down through those same paths) — so no
+        step's metrics outlive the state that produced them.  Each entry
+        then flows through callbacks / reporting / numeric checks with its
+        own step attribution, exactly as the synchronous loop would have.
+        """
+        if not self._metrics_ring:
+            return
+        ring, self._metrics_ring = self._metrics_ring, []
+        steps = tuple(step for step, _ in ring)
+        with pipeline_counters().host_block("metrics-flush", steps=steps):
+            fetched = jax.device_get([metrics for _, metrics in ring])
+        for (step, _), host in zip(ring, fetched):
+            host = {k: float(np.asarray(v)) for k, v in host.items()}
+            self._last_metrics = host
+            if self._on_step is not None:
+                self._on_step(step, host)
+            self._dispatch("on_step_end", step, host)
+            cfg = self.config
+            if step % cfg.report_every == 0 or step == self._fit_max_steps:
+                self._report(host, step=step)
 
     def _dispatch(self, hook: str, *args):
         for cb in self.callbacks:
@@ -196,10 +303,11 @@ class ElasticTrainer:
                 logger.warning("callback %s.%s failed: %s",
                                type(cb).__name__, hook, e)
 
-    def current_lr(self) -> float:
-        """The LR the schedule prescribes at the current step."""
+    def current_lr(self, step: Optional[int] = None) -> float:
+        """The LR the schedule prescribes at ``step`` (default: current)."""
+        step = self.step if step is None else step
         if callable(self.lr_schedule):
-            return float(self.lr_schedule(self.step))
+            return float(self.lr_schedule(step))
         return float(self.lr_schedule)
 
     def evaluate(
@@ -208,18 +316,41 @@ class ElasticTrainer:
         max_batches: int = 0,
     ) -> Dict[str, float]:
         """Forward-only evaluation: mean loss + perplexity over the loader
-        (ref ``atorch_trainer.py`` ``evaluate``/``prediction_loop``)."""
-        total_loss, total_tokens, batches = 0.0, 0.0, 0
+        (ref ``atorch_trainer.py`` ``evaluate``/``prediction_loop``).
+
+        Loss·tokens accumulate ON DEVICE across the loop; one blocking
+        fetch at the end materializes both sums (a per-batch ``float()``
+        would serialize host and device for the whole eval pass).
+        """
+        self._flush_metrics()
+        weighted_loss = total_tokens = None  # device-resident accumulators
+        batches = 0
         for batch in eval_loader:
             if max_batches and batches >= max_batches:
                 break
             placed = train_lib.shard_batch(batch, self.train)
             metrics = self.train.eval_step(self.state, placed)
-            tokens = float(metrics["tokens"])
-            total_loss += float(metrics["loss"]) * tokens
-            total_tokens += tokens
+            weighted = metrics["loss"] * metrics["tokens"]
+            if batches == 0:
+                weighted_loss, total_tokens = weighted, metrics["tokens"]
+            else:
+                weighted_loss = weighted_loss + weighted
+                total_tokens = total_tokens + metrics["tokens"]
             batches += 1
-        mean_loss = total_loss / total_tokens if total_tokens else float("nan")
+        if batches:
+            with pipeline_counters().host_block(
+                "eval-fetch", steps=(self.step,)
+            ):
+                fetched = jax.device_get(
+                    {"loss": weighted_loss, "tokens": total_tokens}
+                )
+            total_tokens = float(np.asarray(fetched["tokens"]))
+            mean_loss = (
+                float(np.asarray(fetched["loss"])) / total_tokens
+                if total_tokens else float("nan")
+            )
+        else:
+            total_tokens, mean_loss = 0.0, float("nan")
         out = {
             "eval_loss": mean_loss,
             "eval_ppl": float(np.exp(min(mean_loss, 30.0))),
@@ -274,6 +405,9 @@ class ElasticTrainer:
             steps_per_epoch = max(1, len(loader))
             # Resume accounting: a restored step implies the epoch.
             self.epoch = self.step // steps_per_epoch
+        self._on_step = on_step
+        self._fit_max_steps = max_steps
+        lag = max(0, cfg.metrics_lag)
         self._dispatch("on_train_begin")
         done = False
         epoch_iterations = max(1, epochs) if epochs else 1
@@ -284,19 +418,29 @@ class ElasticTrainer:
             if epochs and self.epoch >= epoch_iterations:
                 break
             batches_this_pass = 0
-            for batch in loader:
+            for batch in self._batch_stream(loader):
                 batches_this_pass += 1
                 if self.step >= max_steps:
                     done = True
                     break
                 metrics = self.train_step(batch)
-                if on_step is not None:
-                    on_step(self.step, metrics)
-                self._dispatch("on_step_end", self.step, metrics)
-                if self.step % cfg.report_every == 0 or (
-                    self.step == max_steps
-                ):
-                    self._report(metrics)
+                if lag:
+                    # Pipelined: park the device metrics in the ring; they
+                    # materialize (and drive callbacks/reporting with their
+                    # own step attribution) ``lag`` steps later, in one
+                    # batched fetch — the dispatch thread never blocks on
+                    # the step it just enqueued.
+                    self._metrics_ring.append((self.step, metrics))
+                    if len(self._metrics_ring) >= lag:
+                        self._flush_metrics()
+                else:
+                    if on_step is not None:
+                        on_step(self.step, metrics)
+                    self._dispatch("on_step_end", self.step, metrics)
+                    if self.step % cfg.report_every == 0 or (
+                        self.step == max_steps
+                    ):
+                        self._report(metrics)
                 if cfg.eval_every and eval_loader is not None and (
                     self.step % cfg.eval_every == 0
                 ):
@@ -323,6 +467,9 @@ class ElasticTrainer:
                     done = True
                 if not epochs:
                     done = True
+        # End-of-fit barrier: drain whatever the ring still holds so the
+        # final steps' metrics reach callbacks/reports before on_train_end.
+        self._flush_metrics()
         if self._last_saved < self.step:
             # A restart can resume at (or past) max_steps with the newest
             # state only in a previous world's uncommitted files — persist
@@ -337,19 +484,34 @@ class ElasticTrainer:
         self._dispatch("on_train_end", self.step)
         return self.step
 
-    def _report(self, metrics: Dict[str, Any]):
+    def _report(self, metrics: Dict[str, Any], step: Optional[int] = None):
+        """Report ``metrics`` under ``step`` (default: the current step —
+        the synchronous path; the deferred-metrics flush passes the ring
+        entry's own step so lagged values keep correct attribution)."""
         cfg = self.config
-        loss = float(metrics["loss"])
+        step = self.step if step is None else step
+        loss = metrics["loss"]
+        grad_norm = metrics.get("grad_norm")
+        if isinstance(loss, jax.Array):
+            # Synchronous mode's per-step blocking fetch — the "metrics"
+            # block the pipeline counters tally as sync_block_count (and
+            # the pipelined path never reaches: its flush hands host
+            # floats in).  One device_get for both scalars.
+            fetch = {"loss": loss}
+            if grad_norm is not None:
+                fetch["grad_norm"] = grad_norm
+            with pipeline_counters().host_block("metrics", steps=(step,)):
+                fetch = jax.device_get(fetch)
+            loss = fetch["loss"]
+            grad_norm = fetch.get("grad_norm")
+        loss = float(loss)
+        grad_norm = float(grad_norm) if grad_norm is not None else None
         logger.info(
-            "step %d loss %.4f lr %.3g", self.step, loss, self.current_lr()
+            "step %d loss %.4f lr %.3g", step, loss, self.current_lr(step)
         )
         anomalies = ()
         if self.numeric_monitor is not None:
-            grad_norm = metrics.get("grad_norm")
-            found = self.numeric_monitor.check(
-                self.step, loss,
-                float(grad_norm) if grad_norm is not None else None,
-            )
+            found = self.numeric_monitor.check(step, loss, grad_norm)
             if found:
                 for a in found:
                     logger.error("numeric anomaly: %s", a.encode())
@@ -358,7 +520,7 @@ class ElasticTrainer:
                     self._state_poisoned = True
         if self.client is not None:
             self.client.report_step(
-                self.step,
+                step,
                 tokens=cfg.global_batch_size * cfg.seq_len
                 * cfg.report_every,
                 loss=loss,
@@ -371,6 +533,10 @@ class ElasticTrainer:
     # -- checkpoint -----------------------------------------------------------
 
     def save_checkpoint(self):
+        # Checkpoint barrier: drain deferred metrics first, so (a) every
+        # step committed by this save has already been reported/attributed
+        # and (b) _healthy_to_save reads host floats, not device arrays.
+        self._flush_metrics()
         if self._ckpt is None:
             return
         if self._healthy_to_save() is False:

@@ -10,8 +10,10 @@ the logical annotations + rules, and XLA inserts every collective.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -27,6 +29,21 @@ from dlrover_tpu.parallel import rules as lr
 
 class TrainState(flax_train_state.TrainState):
     """step / params / opt_state / apply_fn / tx."""
+
+
+# Retrace accounting: the staged python functions run ONLY while jax traces
+# them, so counting their executions counts (re)traces.  The restart-fast
+# compile path's contract — a second trainer with an identical (config,
+# mesh-shape) performs zero retraces — is asserted against these.
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def trace_count(name: str = "train_step") -> int:
+    return TRACE_COUNTS[name]
+
+
+def reset_trace_counts():
+    TRACE_COUNTS.clear()
 
 
 def use_mesh(mesh: Mesh):
@@ -225,6 +242,10 @@ class ShardedTrain:
     init_fn: Callable[..., TrainState]
     step_fn: Callable[..., Tuple[TrainState, Dict[str, jax.Array]]]
     eval_fn: Optional[Callable] = None
+    # Abstract batch (ShapeDtypeStructs) matching step_fn's second arg —
+    # what aot_compile lowers against without touching real data.
+    batch_avals: Optional[Dict[str, jax.ShapeDtypeStruct]] = None
+    _aot_step: Optional[Callable] = None
 
     def init(self, rng: jax.Array) -> TrainState:
         with use_mesh(self.mesh):
@@ -232,12 +253,34 @@ class ShardedTrain:
 
     def step(self, state: TrainState, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):
-            return self.step_fn(state, batch)
+            fn = self._aot_step if self._aot_step is not None else self.step_fn
+            return fn(state, batch)
 
     def eval_step(self, state: TrainState, batch: Dict[str, jax.Array]):
         """Forward-only loss on one batch -> {"loss", "tokens"}."""
         with use_mesh(self.mesh):
             return self.eval_fn(state, batch)
+
+    def aot_compile(self) -> float:
+        """``lower().compile()`` the train step before the first batch.
+
+        Returns the wall seconds spent (the goodput ledger records it as
+        compile time, not training time).  Subsequent ``step()`` calls run
+        the compiled executable directly, so the jit dispatch path never
+        retraces — and with the persistent compilation cache enabled the
+        XLA compile inside is a disk hit on a post-restart world.
+        """
+        if self._aot_step is not None or self.batch_avals is None:
+            return 0.0
+        t0 = time.perf_counter()
+        with use_mesh(self.mesh):
+            abstract_state = jax.eval_shape(
+                self.init_fn, jax.random.PRNGKey(0)
+            )
+            self._aot_step = self.step_fn.lower(
+                abstract_state, self.batch_avals
+            ).compile()
+        return time.perf_counter() - t0
 
 
 def _sanitize_boxes(tree):
@@ -273,6 +316,17 @@ def logical_sharding(
     return NamedSharding(mesh, spec)
 
 
+# In-process memo of compiled programs, keyed by
+# ``runtime.compile_cache.train_cache_key``: a trainer rebuilt after an
+# elastic resize back to an already-seen (config, mesh-shape) pair reuses
+# the jitted functions — zero retraces, zero XLA compiles.
+_BUILD_CACHE: Dict[str, ShardedTrain] = {}
+
+
+def reset_build_cache():
+    _BUILD_CACHE.clear()
+
+
 def build_sharded_train(
     model: nn.Module,
     optimizer: optax.GradientTransformation,
@@ -283,13 +337,30 @@ def build_sharded_train(
     seq_len: int,
     donate_state: bool = True,
     ce_chunks: int = 0,
+    cache_key: Optional[str] = None,
 ) -> ShardedTrain:
     """Construct init/step functions jitted with mesh shardings.
 
     The batch dict is expected to hold int32 ``inputs`` and ``targets`` of
     shape [global_batch, seq_len] (plus optional fp ``weights``), laid out as
     jax.Arrays sharded batch-over-(data,fsdp) and seq-over-seq.
+
+    ``cache_key`` (from ``runtime.compile_cache.train_cache_key``) opts into
+    the in-process program memo: the caller asserts that equal keys mean an
+    identical (model, optimizer, mesh-shape, batch) recipe, and gets back
+    the previously-built ShardedTrain — no retrace, no recompile.  The memo
+    compares mesh device layout too, so a resize to a genuinely different
+    world never aliases.
     """
+    if cache_key is not None:
+        cached = _BUILD_CACHE.get(cache_key)
+        if cached is not None and (
+            cached.mesh.devices.shape == mesh.devices.shape
+            and list(cached.mesh.devices.flat) == list(mesh.devices.flat)
+        ):
+            logger.info("build_sharded_train: compile-cache hit (%d entries)",
+                        len(_BUILD_CACHE))
+            return cached
     rules = list(rules)
     dummy_tokens = jnp.zeros((global_batch_size, seq_len), jnp.int32)
 
@@ -312,6 +383,7 @@ def build_sharded_train(
         # The runtime state is fully unboxed (raw arrays): unbox applies the
         # logical sharding constraints, then the optimizer inits from plain
         # arrays so factored states (adafactor) get valid shapes.
+        TRACE_COUNTS["init"] += 1
         params = nn.meta.unbox(model.init(rng, dummy_tokens)["params"])
         return _make_state(params, optimizer.init(params))
 
@@ -331,6 +403,8 @@ def build_sharded_train(
     }
 
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        TRACE_COUNTS["train_step"] += 1
+
         def loss_fn(params):
             if ce_chunks:
                 hidden, aux = state.apply_fn(
@@ -371,6 +445,7 @@ def build_sharded_train(
 
     def _eval_step(state: TrainState, batch: Dict[str, jax.Array]):
         """Forward-only CE (the fit-loop's eval half; no state mutation)."""
+        TRACE_COUNTS["eval_step"] += 1
         if ce_chunks:
             hidden, aux = state.apply_fn(
                 {"params": state.params}, batch["inputs"], return_hidden=True
@@ -402,7 +477,10 @@ def build_sharded_train(
         in_shardings=(state_shardings, batch_shardings),
     )
 
-    return ShardedTrain(
+    token_aval = jax.ShapeDtypeStruct(
+        (global_batch_size, seq_len), jnp.int32
+    )
+    train = ShardedTrain(
         mesh=mesh,
         rules=rules,
         state_shardings=state_shardings,
@@ -410,7 +488,17 @@ def build_sharded_train(
         init_fn=init_jit,
         step_fn=step_jit,
         eval_fn=eval_jit,
+        batch_avals={
+            "inputs": token_aval,
+            "targets": token_aval,
+            "weights": jax.ShapeDtypeStruct(
+                (global_batch_size, seq_len), jnp.float32
+            ),
+        },
     )
+    if cache_key is not None:
+        _BUILD_CACHE[cache_key] = train
+    return train
 
 
 def shard_batch(
@@ -426,8 +514,18 @@ def shard_batch(
 
     ``weights`` (per-token loss weights) defaults to all-ones when absent so
     the batch pytree always matches the step's in_shardings.
+
+    ``jax.device_put`` dispatches the H2D copy asynchronously, so calling
+    this one batch ahead of consumption (``data.loader.DevicePrefetcher``)
+    overlaps the copy with the previous step's compute.  A batch that is
+    already device-resident with the right sharding passes through
+    untouched — the trainer can hand prefetched batches back through this
+    function without a second copy (and without logging a second "place"
+    event to the pipeline counters).
     """
     out = {}
+    placed_any = False
+    t0 = time.perf_counter()
     if "weights" not in batch:
         batch = dict(batch)
         batch["weights"] = jnp.ones(
@@ -438,6 +536,10 @@ def shard_batch(
         sharding = train.batch_shardings.get(
             key, train.batch_shardings["inputs"]
         )
+        if isinstance(value, jax.Array) and value.sharding == sharding:
+            out[key] = value  # already placed (prefetched) — passthrough
+            continue
+        placed_any = True
         if multihost:
             import numpy as np
 
@@ -446,4 +548,8 @@ def shard_batch(
             )
         else:
             out[key] = jax.device_put(value, sharding)
+    if placed_any:
+        from dlrover_tpu.utils.profiler import pipeline_counters
+
+        pipeline_counters().record_place(time.perf_counter() - t0)
     return out

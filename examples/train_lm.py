@@ -48,6 +48,19 @@ def parse_args():
                         "(auto_accelerate equivalent)")
     p.add_argument("--optimizer", default="adamw",
                    help="adamw | adafactor | sgd | lion | q8_adam | agd")
+    p.add_argument("--metrics-lag", type=int, default=0,
+                   help="defer metrics materialization by N steps (one "
+                        "batched device fetch per N steps; 0 = sync)")
+    p.add_argument("--prefetch", type=int, default=0,
+                   help="device-resident batches to keep ahead of compute "
+                        "(H2D of batch N+1 overlaps step N; 0 = off)")
+    p.add_argument("--warmup-compile", action="store_true",
+                   help="AOT-compile the step at startup and report the "
+                        "wall time to the master's goodput ledger")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="persistent XLA compilation cache dir (default: "
+                        "$DLROVER_TPU_COMPILE_CACHE, else derived from "
+                        "--checkpoint-dir; restarts skip recompiling)")
     return p.parse_args()
 
 
@@ -90,6 +103,10 @@ def main():
             checkpoint_dir=args.checkpoint_dir,
             ckpt_every=args.ckpt_every,
             auto_tune=args.auto_tune,
+            metrics_lag=args.metrics_lag,
+            prefetch_to_device=args.prefetch,
+            warmup_compile=args.warmup_compile,
+            compile_cache_dir=args.compile_cache_dir,
         ),
         client=client,
     )

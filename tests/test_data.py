@@ -22,6 +22,29 @@ def test_sampler_rank_partition_disjoint():
     assert len(flat) == 100
 
 
+def test_sampler_resume_skew_completed_not_multiple_of_replicas():
+    """Resume with ``completed % num_replicas != 0`` (a resize changed the
+    world mid-epoch): ranks must cover EXACTLY the unconsumed suffix of
+    the shuffled order — no double-consume, no skipped samples."""
+    n, replicas, seed = 29, 3, 7
+    for completed in (4, 7, 11):  # 4%3=1, 7%3=1, 11%3=2 — all skewed
+        assert completed % replicas != 0
+        samplers = [
+            ElasticDistributedSampler(
+                n, num_replicas=replicas, rank=r, shuffle=True, seed=seed
+            )
+            for r in range(replicas)
+        ]
+        for s in samplers:
+            s.load_state_dict({"epoch": 0, "completed": completed})
+        per_rank = [list(s) for s in samplers]
+        flat = sum(per_rank, [])
+        assert len(flat) == len(set(flat))  # no rank double-consumes
+        order = np.random.default_rng(seed).permutation(n)
+        remaining = sorted(int(x) for x in order[completed:])
+        assert sorted(flat) == remaining    # nothing skipped, nothing extra
+
+
 def test_sampler_checkpoint_resume():
     s = ElasticDistributedSampler(64, num_replicas=2, rank=0, shuffle=True)
     full = list(s)

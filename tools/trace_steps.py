@@ -1,0 +1,142 @@
+"""Per-step host-dispatch vs device-compute timeline from the pipeline
+counters (`utils.profiler.StepPipelineCounters`).
+
+Runs a tiny trainer for a handful of steps and dumps, per step, how long
+the host spent enqueueing it (``dispatch_s``) and how much blocking
+device->host sync time was attributed to it (``blocked_s``), plus the
+aggregate pipeline summary.  The headline number is ``sync_block_count``:
+per-step synchronous metric fetches, which MUST be 0 in pipelined mode
+(``--metrics-lag > 0``) — the tier-1 assertion in
+``tests/test_step_pipeline.py`` wraps exactly this tool.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/trace_steps.py --steps 8 --metrics-lag 4
+    python tools/trace_steps.py --metrics-lag 0   # the synchronous baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def make_batches(
+    steps: int, vocab: int, seq_len: int, batch: int, seed: int = 0
+):
+    """A fixed, re-iterable list of synthetic LM batches."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(steps):
+        tokens = rng.integers(
+            0, vocab, size=(batch, seq_len + 1), dtype=np.int32
+        )
+        out.append({
+            "inputs": tokens[:, :-1].copy(),
+            "targets": tokens[:, 1:].copy(),
+        })
+    return out
+
+
+def run_trace(
+    steps: int = 8,
+    metrics_lag: int = 4,
+    prefetch: int = 2,
+    report_every: int = 1,
+    vocab: int = 128,
+    seq_len: int = 32,
+    batch: int = 8,
+    layers: int = 2,
+    d_model: int = 64,
+    heads: int = 2,
+) -> dict:
+    """Train ``steps`` tiny steps and return the pipeline timeline.
+
+    ``metrics_lag=0, prefetch=0`` reproduces the synchronous loop (one
+    "metrics" block per reported step); the pipelined settings must show
+    ``sync_block_count == 0`` with only "metrics-flush" blocks instead.
+    """
+    import jax  # noqa: F401  (backend init before building the trainer)
+
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+    from dlrover_tpu.utils.profiler import pipeline_counters
+
+    config = gpt2_config(
+        "124m",
+        num_layers=layers,
+        d_model=d_model,
+        num_heads=heads,
+        vocab_size=vocab,
+        max_seq_len=seq_len,
+    )
+    trainer = ElasticTrainer(
+        config,
+        TrainerConfig(
+            global_batch_size=batch,
+            seq_len=seq_len,
+            report_every=report_every,
+            metrics_lag=metrics_lag,
+            prefetch_to_device=prefetch,
+        ),
+        client=None,
+    )
+    batches = make_batches(steps, vocab, seq_len, batch)
+    counters = pipeline_counters()
+    counters.reset()
+    trainer.fit(batches, max_steps=steps)
+    trainer.close()
+    table = counters.per_step_table()
+    summary = counters.summary()
+    return {
+        "mode": "pipelined" if metrics_lag > 0 else "sync",
+        "steps": steps,
+        "metrics_lag": metrics_lag,
+        "prefetch": prefetch,
+        "per_step": table,
+        "summary": summary,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--metrics-lag", type=int, default=4)
+    p.add_argument("--prefetch", type=int, default=2)
+    p.add_argument("--report-every", type=int, default=1)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--heads", type=int, default=2)
+    args = p.parse_args()
+    out = run_trace(
+        steps=args.steps,
+        metrics_lag=args.metrics_lag,
+        prefetch=args.prefetch,
+        report_every=args.report_every,
+        vocab=args.vocab,
+        seq_len=args.seq_len,
+        batch=args.batch,
+        layers=args.layers,
+        d_model=args.d_model,
+        heads=args.heads,
+    )
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -197,6 +197,21 @@ class MasterClient:
     def report_event(self, event: str, detail: str = ""):
         self.report(msg.NodeEventReport(self.node_id, event, detail))
 
+    def report_telemetry(self, events, dropped: int = 0):
+        """Ship one drained telemetry batch (common/telemetry.py wire
+        tuples) to the master's job timeline."""
+        self.report(msg.TelemetryEvents(
+            self.node_id, tuple(events), dropped
+        ))
+
+    def get_metrics_text(self) -> str:
+        """The master's Prometheus-style exposition (render_metrics)."""
+        return self.get(msg.MetricsRequest()).payload
+
+    def get_timeline(self, node_id: int = -1):
+        """Merged job-timeline wire events: {node_id: [event, ...]}."""
+        return self.get(msg.TimelineRequest(node_id)).payload
+
     def get_job_status(self) -> msg.JobStatus:
         return self.get(msg.JobStatusRequest()).payload
 

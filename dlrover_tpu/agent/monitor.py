@@ -78,10 +78,13 @@ class ResourceMonitor:
     """Samples host + device telemetry and reports it to the master."""
 
     def __init__(self, client, interval: float = 30.0,
-                 metrics_file: Optional[str] = None):
+                 metrics_file: Optional[str] = None, recorder=None):
         self._client = client
         self._interval = interval
         self._metrics_file = metrics_file
+        # Optional agent telemetry recorder: shipped on the resource
+        # cadence as a backstop for the heartbeat drain.
+        self._recorder = recorder
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_cpu: Optional[Tuple[float, float]] = None
@@ -127,6 +130,8 @@ class ResourceMonitor:
                     s["cpu_percent"], s["mem_gb"],
                     s["device_mem_gb"], s["device_util"],
                 )
+                if self._recorder is not None:
+                    self._recorder.ship(self._client)
             except ConnectionError:
                 logger.warning("resource report: master unreachable")
             except Exception as e:  # noqa: BLE001 - telemetry must not kill

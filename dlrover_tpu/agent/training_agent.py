@@ -24,6 +24,7 @@ import time
 from enum import Enum
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
@@ -170,6 +171,10 @@ class ElasticAgent:
         self.master_addr = master_addr
         self.node_id = node_id
         self.client = MasterClient(master_addr, node_id=node_id)
+        # Own recorder (not the module singleton): in-process tests run
+        # agent and trainer side by side, and their streams must keep
+        # distinct ``src`` lanes in the merged timeline.
+        self.telemetry = telemetry.TelemetryRecorder(source="agent")
         self._rdzv = MasterRendezvousHandler(self.client, node_id, config)
         self._proc: Optional[subprocess.Popen] = None
         self._restart_count = 0
@@ -270,7 +275,13 @@ class ElasticAgent:
             return ""
 
     def _start_workers(self) -> Dict:
-        rdzv = self._rdzv.next_rendezvous()
+        # The rendezvous span IS the job's idle gap: its duration in the
+        # merged timeline is time this host spent outside training.
+        with self.telemetry.span("rendezvous") as sp:
+            rdzv = self._rdzv.next_rendezvous()
+            if sp is not None:
+                sp.attrs["round"] = rdzv["round"]
+                sp.attrs["world"] = len(rdzv["world"])
         self._current_round = rdzv["round"]
         env = dict(os.environ)
         env.update(
@@ -342,6 +353,10 @@ class ElasticAgent:
         self._first_step_confirmed = False
         self._last_log_size = -1
         self._last_activity_wallclock = time.time()
+        self.telemetry.event(
+            "worker_start", restart=self._restart_count,
+            round=rdzv["round"],
+        )
         self.client.report_event("started")
         return rdzv
 
@@ -458,6 +473,7 @@ class ElasticAgent:
                 "\n".join(stacks.splitlines()[:60]),
             )
         self._restart_count += 1
+        self.telemetry.event("restart", restart_count=self._restart_count)
         self._stop_workers()
         self._start_workers()
 
@@ -500,6 +516,7 @@ class ElasticAgent:
         while not self._stop.is_set():
             try:
                 self.client.report_heartbeat()
+                self.telemetry.ship(self.client)
             except ConnectionError:
                 logger.warning("heartbeat: master unreachable")
             self._poll_paral_config()
@@ -511,7 +528,10 @@ class ElasticAgent:
         if self.config.network_check:
             from dlrover_tpu.agent.node_check import run_network_check
 
-            ok = run_network_check(self.client, self.node_id)
+            with self.telemetry.span("node_check") as sp:
+                ok = run_network_check(self.client, self.node_id)
+                if sp is not None:
+                    sp.attrs["ok"] = bool(ok)
             if not ok:
                 self.client.report_failure(
                     "network check failed", level="node"
@@ -528,6 +548,7 @@ class ElasticAgent:
             self.client,
             interval=self.config.resource_report_interval,
             metrics_file=self._metrics_file(),
+            recorder=self.telemetry,
         )
         self._resource_monitor.start()
         self._start_workers()
@@ -591,6 +612,7 @@ class ElasticAgent:
                     return RunResult.FAILED
                 continue
             if code == 0:
+                self.telemetry.event("process_exit", code=0)
                 self.client.report_event("succeeded")
                 if self._saver is not None:
                     # Drain pending persists before declaring success.
@@ -598,6 +620,10 @@ class ElasticAgent:
                 return RunResult.SUCCEEDED
             # Failure path.
             logger.error("trainer exited with code %d", code)
+            self.telemetry.event(
+                "process_exit", code=code,
+                restart_count=self._restart_count,
+            )
             self._save_ckpt_to_storage()
             tail = self._tail_log(30)
             error = f"exit code {code}"
@@ -633,4 +659,8 @@ class ElasticAgent:
         self._stop_workers()
         if self._saver is not None:
             self._saver.stop(unlink_shm=job_succeeded)
+        try:
+            self.telemetry.ship(self.client)
+        except Exception:  # noqa: BLE001 - master may already be gone
+            pass
         self.client.close()

@@ -55,6 +55,9 @@ class DiagnosisContext:
     node_manager: object
     hang_threshold: float = 300.0
     resource_stale_s: float = 300.0
+    # Merged job timeline (master/timeline.py) — step-skew evidence for
+    # the StragglerOperator.  Optional: None disables skew rules.
+    timeline: object = None
 
 
 class TrainingHangOperator(InferenceOperator):
@@ -131,6 +134,49 @@ class NodeFlappingOperator(InferenceOperator):
         return out
 
 
+class StragglerOperator(InferenceOperator):
+    """Cross-node step-skew attribution from the job timeline: in a
+    synchronous SPMD step every host blocks on the slowest participant, so
+    one node persistently above K x the per-step median silently taxes the
+    whole job (same signal class the network-check rendezvous measures,
+    but continuous, from real training steps).  Surfaced as a REPORT —
+    demoting a slow-but-correct host is an operator/scaler policy call.
+    """
+
+    name = "straggler"
+    SKEW_RATIO = 2.0       # K: slow means > K x per-step median
+    MIN_STEPS = 8          # attribution window must hold this many steps
+    MIN_SKEW_FRACTION = 0.5  # ...and the node slow in at least this share
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        timeline = getattr(ctx, "timeline", None)
+        if timeline is None:
+            return []
+        observed = timeline.steps_observed()
+        if observed < self.MIN_STEPS:
+            return []
+        skew = timeline.step_skew(self.SKEW_RATIO)
+        out = []
+        for node_id, slow_steps in sorted(skew.items()):
+            if slow_steps < self.MIN_SKEW_FRACTION * observed:
+                continue
+            stats = timeline.step_stats().get(node_id, {})
+            out.append(
+                DiagnosisAction(
+                    ActionType.REPORT,
+                    reason=(
+                        f"node {node_id} is a straggler: slower than "
+                        f"{self.SKEW_RATIO:g}x the step median in "
+                        f"{slow_steps}/{observed} recent steps "
+                        f"(p50 {stats.get('p50', 0.0):.3f}s)"
+                    ),
+                    node_id=node_id,
+                    severity=1,
+                )
+            )
+        return out
+
+
 class NumericAnomalyOperator(InferenceOperator):
     """Numeric-health input to the chain (ref ``loss_spike_utils.py`` +
     ``numberic_checker.py``, which the reference leaves as offline tools —
@@ -199,6 +245,7 @@ class InferenceChain:
             TrainingHangOperator(),
             ResourceStallOperator(),
             NodeFlappingOperator(),
+            StragglerOperator(),
             NumericAnomalyOperator(),
         ]
 

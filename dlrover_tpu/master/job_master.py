@@ -52,10 +52,13 @@ class JobMaster:
         brain_overrides: Optional[Dict[str, float]] = None,
         pools: Optional[Dict[str, int]] = None,
     ):
+        from dlrover_tpu.master.timeline import JobTimeline
+
         self.speed_monitor = SpeedMonitor()
         self.task_manager = TaskManager()
         self.kv_store = KVStore()
         self.metrics = MetricsCollector()
+        self.timeline = JobTimeline()
         self._launcher = launcher
         self._pending_dead_ticks: Dict[int, int] = {}
         self.node_manager = NodeManager(
@@ -65,6 +68,18 @@ class JobMaster:
             heartbeat_timeout=heartbeat_timeout,
             pools=pools,
         )
+        # A node leaving the job through node_manager itself (retire,
+        # migration completion) must drop its observability series the
+        # same way the scaler's retire hook does — otherwise a replaced
+        # host's samples keep skewing job aggregates and straggler stats.
+        from dlrover_tpu.master.node_manager import NodeStatus as _NS
+
+        def _evict_observability(node_id, old_status, new_status):
+            if new_status == _NS.SUCCEEDED:
+                self.metrics.evict(node_id)
+                self.timeline.evict_node(node_id)
+
+        self.node_manager.add_callback(_evict_observability)
         from dlrover_tpu.master.brain import RunningJobOptimizer
 
         self.auto_scaler = JobAutoScaler(
@@ -125,6 +140,7 @@ class JobMaster:
             speed_monitor=self.speed_monitor,
             kv_store=self.kv_store,
             metrics=self.metrics,
+            timeline=self.timeline,
         )
         self._server = None
         self.port = port
@@ -288,6 +304,7 @@ class JobMaster:
             metrics=self.metrics,
             node_manager=self.node_manager,
             hang_threshold=self.hang_threshold,
+            timeline=self.timeline,
         )
         for action in self.diagnosis.run(ctx):
             logger.error("diagnosis remediation: %s (%s)",
@@ -323,10 +340,15 @@ class JobMaster:
 
     def _handle_node_retired(self, node_id: int):
         """Scale-down teardown: survivors must see the broken world and
-        re-form (otherwise their trainers hang in dead collectives)."""
+        re-form (otherwise their trainers hang in dead collectives).  The
+        departed node's observability series go too — a retired host's
+        stale resource samples and step durations would pollute job
+        aggregates (mean_cpu, staleness sweeps) and straggler stats."""
         for manager in self.rdzv_managers.values():
             manager.remove_alive_node(node_id)
         self.task_manager.recover_tasks(node_id)
+        self.metrics.evict(node_id)
+        self.timeline.evict_node(node_id)
 
     def stop(self):
         self._stop.set()

@@ -215,6 +215,35 @@ class ResourceStats:
 
 
 @dataclasses.dataclass
+class TelemetryEvents:
+    """One drained batch of a node's telemetry ring (common/telemetry.py):
+    tuples of (name, kind, t_wall, duration_s, attrs) — plain builtins
+    only, so the restricted unpickler admits them.  ``dropped`` reports
+    ring overwrites since the last drain (an observability gap marker,
+    not an error)."""
+
+    node_id: int
+    events: Tuple = ()
+    dropped: int = 0
+
+
+@dataclasses.dataclass
+class MetricsRequest:
+    """Fetch the master's Prometheus-style text exposition
+    (master/timeline.py ``render_metrics``)."""
+
+    pass
+
+
+@dataclasses.dataclass
+class TimelineRequest:
+    """Fetch the merged job timeline's wire events ({node_id: [event...]});
+    ``node_id`` < 0 means all nodes."""
+
+    node_id: int = -1
+
+
+@dataclasses.dataclass
 class JobStatusRequest:
     pass
 

@@ -40,6 +40,13 @@ class MetricsCollector:
             )
             series.append((ts, cpu_percent, mem_gb, device_mem_gb, device_util))
 
+    def evict(self, node_id: int):
+        """Drop a removed node's series (scale-down, migration-out):
+        a departed host must stop feeding ``mean_cpu`` and showing up in
+        ``stale_nodes`` forever as "stopped reporting"."""
+        with self._lock:
+            self._series.pop(node_id, None)
+
     def latest(self, node_id: int) -> Optional[Dict[str, float]]:
         with self._lock:
             series = self._series.get(node_id)

@@ -37,6 +37,7 @@ class MasterServicer:
         kv_store=None,
         paral_config=None,
         metrics=None,
+        timeline=None,
     ):
         self.rdzv_managers = rdzv_managers or {}
         self.task_manager = task_manager
@@ -45,6 +46,7 @@ class MasterServicer:
         self.kv_store = kv_store
         self.paral_config = paral_config or msg.ParalConfig()
         self.metrics = metrics
+        self.timeline = timeline
         from dlrover_tpu.master.sync_service import SyncService
 
         self.sync_service = SyncService()
@@ -62,6 +64,8 @@ class MasterServicer:
             msg.SyncJoin: self._join_sync,
             msg.SyncQuery: self._query_sync,
             msg.ClusterVersion: self._cluster_version,
+            msg.MetricsRequest: self._get_metrics_text,
+            msg.TimelineRequest: self._get_timeline,
         }
         self._report_handlers: Dict[Type, Callable] = {
             msg.JoinRendezvous: self._join_rendezvous,
@@ -75,6 +79,7 @@ class MasterServicer:
             msg.NodeEventReport: self._report_event,
             msg.ResourceStats: self._report_resource,
             msg.ShardCheckpoint: self._restore_shard_checkpoint,
+            msg.TelemetryEvents: self._report_telemetry,
         }
 
     # -- RPC entry points -----------------------------------------------------
@@ -227,6 +232,33 @@ class MasterServicer:
                 )
         if self.node_manager:
             self.node_manager.report_event(p.node_id, p.event, p.detail)
+
+    def _report_telemetry(self, env: msg.Envelope):
+        p: msg.TelemetryEvents = env.payload
+        if self.timeline is None:
+            return
+        node = p.node_id if p.node_id >= 0 else env.node_id
+        self.timeline.add_events(node, p.events)
+        if p.dropped:
+            logger.warning(
+                "node %d telemetry ring overwrote %d events before this "
+                "drain (raise DLROVER_TPU_TELEMETRY_RING?)",
+                node, p.dropped,
+            )
+
+    def _get_metrics_text(self, env: msg.Envelope) -> str:
+        if self.timeline is None:
+            return ""
+        return self.timeline.render_metrics(
+            speed_monitor=self.speed_monitor,
+            node_manager=self.node_manager,
+        )
+
+    def _get_timeline(self, env: msg.Envelope):
+        if self.timeline is None:
+            return {}
+        p: msg.TimelineRequest = env.payload
+        return self.timeline.events(p.node_id if p.node_id >= 0 else None)
 
     def _report_resource(self, env: msg.Envelope):
         p: msg.ResourceStats = env.payload

@@ -1,0 +1,300 @@
+"""Master-side job timeline: merged per-node telemetry + metrics exposition.
+
+The second tier of the observability plane.  Each node's trainer/agent
+drains its :mod:`dlrover_tpu.common.telemetry` ring into ``TelemetryEvents``
+reports; the servicer feeds them here.  The timeline keeps a bounded
+per-node event history keyed by ``(node_id, span)``, and on top of the
+merge answers the three questions the control plane needs:
+
+* **What was the job doing at second T?** — ``to_chrome_trace()`` renders
+  the whole run (steps, compiles, checkpoints, rendezvous gaps, restarts)
+  as a Perfetto/Chrome trace with one track per node
+  (``tools/job_timeline.py`` dumps it).
+* **How healthy is it right now?** — ``render_metrics()`` is a
+  Prometheus-style text exposition: goodput, per-node step-time p50/p95,
+  restart counts, compile seconds, numeric anomalies — served through the
+  servicer's ``MetricsRequest`` seam.
+* **Which node makes it slow?** — per-step cross-node skew attribution
+  (``slowest_per_step`` histogram + ``step_stats``) feeding the
+  ``StragglerOperator`` in ``master/diagnosis.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter, deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common.telemetry import WireEvent, events_to_chrome_trace
+
+
+def _quantile(sorted_values: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile over an already-sorted sequence."""
+    if not sorted_values:
+        return 0.0
+    index = min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    return sorted_values[index]
+
+
+class JobTimeline:
+    """Merged, bounded, thread-safe per-node event streams."""
+
+    # Events retained per node; at ~3 events/step this is hours of history
+    # for the exposition while keeping a 1000-node master's footprint flat.
+    EVENTS_PER_NODE = 8192
+    # Per-step durations retained for skew attribution.
+    STEP_WINDOW = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: Dict[int, Deque[WireEvent]] = {}
+        # step -> {node_id: duration_s} for "step" spans (skew attribution).
+        self._step_durations: Dict[int, Dict[int, float]] = {}
+        self._step_order: Deque[int] = deque()
+        # Lifecycle counters folded out of agent event streams.
+        self._restart_counts: Counter = Counter()
+
+    # -- ingestion ------------------------------------------------------------
+
+    def add_events(self, node_id: int, events: Sequence[WireEvent]):
+        """Ingest one node's drained telemetry batch (the wire format)."""
+        with self._lock:
+            ring = self._events.setdefault(
+                int(node_id), deque(maxlen=self.EVENTS_PER_NODE)
+            )
+            for raw in events:
+                try:
+                    name, kind, t_wall, duration_s, attrs = raw
+                except (TypeError, ValueError):
+                    continue  # one malformed event must not drop the batch
+                attrs = attrs if isinstance(attrs, dict) else {}
+                ring.append(
+                    (str(name), str(kind), float(t_wall),
+                     float(duration_s), attrs)
+                )
+                if name == "step" and "step" in attrs:
+                    self._note_step_locked(
+                        int(node_id), int(attrs["step"]), float(duration_s)
+                    )
+                elif name == "restart":
+                    self._restart_counts[int(node_id)] += 1
+
+    def record(self, node_id: int, name: str, kind: str = "event",
+               t_wall: float = 0.0, duration_s: float = 0.0,
+               attrs: Optional[Dict[str, Any]] = None):
+        """Master-local convenience for single events (tests, master's own
+        lifecycle annotations)."""
+        self.add_events(
+            node_id, [(name, kind, t_wall, duration_s, attrs or {})]
+        )
+
+    def _note_step_locked(self, node_id: int, step: int, duration_s: float):
+        if step not in self._step_durations:
+            self._step_durations[step] = {}
+            self._step_order.append(step)
+            while len(self._step_order) > self.STEP_WINDOW:
+                self._step_durations.pop(self._step_order.popleft(), None)
+        self._step_durations[step][node_id] = duration_s
+
+    def evict_node(self, node_id: int):
+        """Drop a departed node's streams so replaced/retired hosts stop
+        polluting skew stats and the exposition (paired with
+        ``MetricsCollector.evict``)."""
+        with self._lock:
+            self._events.pop(node_id, None)
+            self._restart_counts.pop(node_id, None)
+            for per_node in self._step_durations.values():
+                per_node.pop(node_id, None)
+
+    # -- queries --------------------------------------------------------------
+
+    def nodes(self) -> List[int]:
+        with self._lock:
+            return sorted(self._events)
+
+    def events(self, node_id: Optional[int] = None) -> Dict[int, List[WireEvent]]:
+        """Snapshot of the merged streams (all nodes, or one)."""
+        with self._lock:
+            if node_id is not None:
+                return {node_id: list(self._events.get(node_id, ()))}
+            return {n: list(ring) for n, ring in self._events.items()}
+
+    def spans(self, node_id: int, name: str) -> List[WireEvent]:
+        with self._lock:
+            return [
+                e for e in self._events.get(node_id, ())
+                if e[0] == name and e[1] == "span"
+            ]
+
+    def restart_count(self, node_id: int) -> int:
+        with self._lock:
+            return self._restart_counts.get(node_id, 0)
+
+    # -- skew attribution -----------------------------------------------------
+
+    def step_stats(self) -> Dict[int, Dict[str, float]]:
+        """Per-node step-duration stats over the window:
+        {node: {count, p50, p95, mean}}."""
+        with self._lock:
+            per_node: Dict[int, List[float]] = {}
+            for durations in self._step_durations.values():
+                for node_id, duration in durations.items():
+                    per_node.setdefault(node_id, []).append(duration)
+        out = {}
+        for node_id, values in per_node.items():
+            values.sort()
+            out[node_id] = {
+                "count": float(len(values)),
+                "p50": _quantile(values, 0.50),
+                "p95": _quantile(values, 0.95),
+                "mean": sum(values) / len(values),
+            }
+        return out
+
+    def slowest_per_step(self) -> Counter:
+        """Histogram: node -> number of (multi-node) steps it was the
+        slowest participant of.  A flat histogram is a healthy world; one
+        node owning it is the straggler signature."""
+        slowest: Counter = Counter()
+        with self._lock:
+            for durations in self._step_durations.values():
+                if len(durations) < 2:
+                    continue
+                slowest[max(durations, key=durations.get)] += 1
+        return slowest
+
+    def step_skew(self, ratio: float) -> Dict[int, int]:
+        """node -> count of steps where its duration exceeded ``ratio`` x
+        the per-step median (the StragglerOperator's evidence)."""
+        out: Counter = Counter()
+        with self._lock:
+            step_maps = [dict(d) for d in self._step_durations.values()]
+        for durations in step_maps:
+            if len(durations) < 2:
+                continue
+            values = sorted(durations.values())
+            median = values[len(values) // 2]
+            if median <= 0:
+                continue
+            for node_id, duration in durations.items():
+                if duration > ratio * median:
+                    out[node_id] += 1
+        return dict(out)
+
+    def steps_observed(self) -> int:
+        """Multi-node steps inside the attribution window."""
+        with self._lock:
+            return sum(
+                1 for d in self._step_durations.values() if len(d) >= 2
+            )
+
+    # -- exports --------------------------------------------------------------
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        return events_to_chrome_trace(self.events())
+
+    def render_metrics(
+        self,
+        speed_monitor=None,
+        node_manager=None,
+    ) -> str:
+        """Prometheus text exposition of the merged job state.
+
+        Serves the master's own ledgers (goodput, speed, compile ledger,
+        numeric anomalies — the previously write-only ``SpeedMonitor``
+        state) alongside the timeline-derived per-node series.  Metric
+        names are documented in PROFILE.md "Job timeline".
+        """
+        lines: List[str] = []
+
+        def gauge(name: str, value: float, help_text: str = "",
+                  labels: str = ""):
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{labels} {value:.6g}")
+
+        if speed_monitor is not None:
+            gauge("dlrover_goodput", speed_monitor.goodput(),
+                  "productive_time / wall_time since job start (0..1)")
+            gauge("dlrover_global_step", speed_monitor.global_step,
+                  "newest committed global step")
+            gauge("dlrover_running_speed_steps_per_s",
+                  speed_monitor.running_speed(),
+                  "steps/s over the sample window")
+            gauge("dlrover_token_throughput_per_s",
+                  speed_monitor.token_throughput(),
+                  "tokens/s over the sample window")
+            ledger = speed_monitor.compile_ledger()
+            gauge("dlrover_compile_seconds_total", ledger["compile_s"],
+                  "trainer-reported compile wall seconds")
+            gauge("dlrover_restart_compile_seconds_total",
+                  ledger["restart_compile_s"],
+                  "compile seconds paid on restarts (cache misses)")
+            gauge("dlrover_compile_events_total", ledger["compile_events"])
+            gauge("dlrover_cached_compiles_total", ledger["cached_compiles"])
+            anomalies = speed_monitor.recent_anomalies()
+            kinds: Counter = Counter(
+                encoded.split("@", 1)[0] for _, _, encoded in anomalies
+            )
+            lines.append(
+                "# HELP dlrover_numeric_anomalies_recent anomaly reports "
+                "inside the 600s window, by kind"
+            )
+            lines.append("# TYPE dlrover_numeric_anomalies_recent gauge")
+            if kinds:
+                for kind, count in sorted(kinds.items()):
+                    gauge("dlrover_numeric_anomalies_recent", count,
+                          labels=f'{{kind="{kind}"}}')
+            else:
+                gauge("dlrover_numeric_anomalies_recent", 0)
+
+        stats = self.step_stats()
+        if stats:
+            lines.append(
+                "# HELP dlrover_step_time_seconds per-node step span "
+                "duration quantiles over the attribution window"
+            )
+            lines.append("# TYPE dlrover_step_time_seconds gauge")
+            for node_id in sorted(stats):
+                for q in ("p50", "p95"):
+                    gauge(
+                        "dlrover_step_time_seconds", stats[node_id][q],
+                        labels=(
+                            f'{{node="{node_id}",quantile='
+                            f'"0.{q[1:]}"}}'
+                        ),
+                    )
+        slowest = self.slowest_per_step()
+        if slowest:
+            lines.append(
+                "# HELP dlrover_slowest_steps_total multi-node steps this "
+                "node was the slowest participant of"
+            )
+            lines.append("# TYPE dlrover_slowest_steps_total gauge")
+            for node_id in sorted(slowest):
+                gauge("dlrover_slowest_steps_total", slowest[node_id],
+                      labels=f'{{node="{node_id}"}}')
+        with self._lock:
+            restart_counts = dict(self._restart_counts)
+        if restart_counts or node_manager is not None:
+            lines.append(
+                "# HELP dlrover_restart_events_total trainer restarts "
+                "observed in the node's agent stream"
+            )
+            lines.append("# TYPE dlrover_restart_events_total gauge")
+            for node_id in sorted(restart_counts):
+                gauge("dlrover_restart_events_total",
+                      restart_counts[node_id],
+                      labels=f'{{node="{node_id}"}}')
+        if node_manager is not None:
+            lines.append(
+                "# HELP dlrover_node_relaunch_count relaunches consumed "
+                "from the node's budget"
+            )
+            lines.append("# TYPE dlrover_node_relaunch_count gauge")
+            for node_id, state in sorted(node_manager.snapshot().items()):
+                gauge("dlrover_node_relaunch_count",
+                      state["relaunch_count"],
+                      labels=f'{{node="{node_id}"}}')
+        return "\n".join(lines) + "\n"

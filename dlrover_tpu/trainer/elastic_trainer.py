@@ -27,6 +27,7 @@ import jax
 import numpy as np
 import optax
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 from dlrover_tpu.parallel import rules as lr
@@ -211,6 +212,7 @@ class ElasticTrainer:
                 "cached": compile_s == 0.0,
             }
             logger.info("compile warmup: %s", detail)
+            telemetry.event("compile", duration_s=compile_s, **detail)
             if self.client is not None:
                 self.client.report_event("compile", json.dumps(detail))
         self.state = self.train.init(jax.random.PRNGKey(0))
@@ -223,10 +225,11 @@ class ElasticTrainer:
             self._ckpt = Checkpointer(
                 config.checkpoint_dir, local_saver=not renv.under_agent()
             )
-            restored_step, restored = self._ckpt.load_checkpoint(
-                shardings=self.train.state_shardings,
-                state_template=self.state,
-            )
+            with telemetry.span("restore"):
+                restored_step, restored = self._ckpt.load_checkpoint(
+                    shardings=self.train.state_shardings,
+                    state_template=self.state,
+                )
             if restored is not None:
                 self.state = restored
                 self.step = restored_step
@@ -243,13 +246,18 @@ class ElasticTrainer:
     # -- loop -----------------------------------------------------------------
 
     def train_step(self, batch: Dict[str, Any]):
-        placed = train_lib.shard_batch(batch, self.train)
-        t0 = time.perf_counter()
-        self.state, metrics = self.train.step(self.state, placed)
-        self.step += 1
-        pipeline_counters().record_dispatch(
-            self.step, time.perf_counter() - t0
-        )
+        # The span times what the host observes of this step: H2D place +
+        # dispatch, plus any backpressure XLA applies when the device falls
+        # behind — exactly the per-node signal the master's step-skew
+        # attribution compares across hosts.
+        with telemetry.span("step", step=self.step + 1):
+            placed = train_lib.shard_batch(batch, self.train)
+            t0 = time.perf_counter()
+            self.state, metrics = self.train.step(self.state, placed)
+            self.step += 1
+            pipeline_counters().record_dispatch(
+                self.step, time.perf_counter() - t0
+            )
         self._last_metrics = metrics
         return metrics
 
@@ -323,6 +331,7 @@ class ElasticTrainer:
         would serialize host and device for the whole eval pass).
         """
         self._flush_metrics()
+        t_eval = time.monotonic()
         weighted_loss = total_tokens = None  # device-resident accumulators
         batches = 0
         for batch in eval_loader:
@@ -360,6 +369,10 @@ class ElasticTrainer:
         logger.info(
             "eval @ step %d: loss %.4f ppl %.2f (%d batches)",
             self.step, mean_loss, out["eval_ppl"], batches,
+        )
+        telemetry.event(
+            "eval", duration_s=time.monotonic() - t_eval,
+            step=self.step, batches=batches,
         )
         self._dispatch("on_evaluate", self.step, out)
         return out
@@ -482,6 +495,11 @@ class ElasticTrainer:
             tokens / elapsed if elapsed > 0 else 0.0,
         )
         self._dispatch("on_train_end", self.step)
+        if self.client is not None:
+            try:
+                telemetry.recorder().ship(self.client)
+            except Exception as e:  # noqa: BLE001 - telemetry is best-effort
+                logger.warning("final telemetry ship failed: %s", e)
         return self.step
 
     def _report(self, metrics: Dict[str, Any], step: Optional[int] = None):
@@ -526,6 +544,9 @@ class ElasticTrainer:
                 loss=loss,
                 anomalies=anomalies,
             )
+            # Piggyback the telemetry drain on the report cadence: one
+            # extra RPC per report window, never per step.
+            telemetry.recorder().ship(self.client)
         from dlrover_tpu.agent.monitor import write_device_metrics
 
         write_device_metrics()
@@ -548,7 +569,10 @@ class ElasticTrainer:
             return
         from dlrover_tpu.checkpoint import StorageType
 
-        self._ckpt.save_checkpoint(self.step, self.state, StorageType.DISK)
+        with telemetry.span("checkpoint", step=self.step):
+            self._ckpt.save_checkpoint(
+                self.step, self.state, StorageType.DISK
+            )
         self._last_saved = self.step
         self._dispatch("on_checkpoint", self.step)
 

@@ -30,6 +30,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from dlrover_tpu.common import telemetry as _telemetry
+
 
 @dataclasses.dataclass
 class OpProfile:
@@ -275,6 +277,12 @@ class StepPipelineCounters:
                 self.events.append(
                     PipelineEvent("block", label, t0, dt, tuple(steps))
                 )
+            # Host blocks are the pipeline's stalls — fold them into the
+            # job timeline so metrics-flush/eval-fetch slices sit next to
+            # the trainer's step spans in the merged Perfetto trace.
+            _telemetry.event(
+                label, duration_s=dt, kind="block", steps=tuple(steps)
+            )
 
     def record_place(self, duration_s: float = 0.0, label: str = "h2d"):
         with self._lock:
@@ -284,6 +292,9 @@ class StepPipelineCounters:
                 PipelineEvent("place", label, time.perf_counter(),
                               duration_s, (index,))
             )
+        if duration_s > 0.0:
+            _telemetry.event(label, duration_s=duration_s, kind="place",
+                             batch=index)
 
     def record_dispatch(self, step: int, duration_s: float):
         with self._lock:

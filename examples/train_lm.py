@@ -61,6 +61,10 @@ def parse_args():
                    help="persistent XLA compilation cache dir (default: "
                         "$DLROVER_TPU_COMPILE_CACHE, else derived from "
                         "--checkpoint-dir; restarts skip recompiling)")
+    p.add_argument("--timeline", default="",
+                   help="write this process's telemetry (step/compile/"
+                        "checkpoint spans) as a Chrome-trace JSON at exit "
+                        "— open at https://ui.perfetto.dev")
     return p.parse_args()
 
 
@@ -147,7 +151,43 @@ def main():
 
     trainer.fit(loader, max_steps=args.steps, on_step=on_step)
     trainer.close()
+    if args.timeline:
+        _write_timeline(args.timeline, client)
     return 0
+
+
+def _write_timeline(path: str, client):
+    """Dump the run's telemetry as a Chrome trace.
+
+    With a master attached, its merged timeline covers every node (and
+    already holds what this trainer shipped on report cadence); standalone
+    runs fall back to this process's own ring.
+    """
+    import json
+
+    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.common.log import default_logger as logger
+    from dlrover_tpu.runtime import env as renv
+
+    events = {}
+    if client is not None:
+        try:
+            events = {
+                int(n): list(evs)
+                for n, evs in client.get_timeline().items()
+            }
+        except Exception as e:  # noqa: BLE001 - best-effort at exit
+            logger.warning("timeline fetch from master failed: %s", e)
+    local = telemetry.recorder().drain()
+    if local or not events:
+        events.setdefault(renv.node_id(), []).extend(local)
+    trace = telemetry.events_to_chrome_trace(events)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    logger.info(
+        "timeline: %d events -> %s",
+        sum(len(evs) for evs in events.values()), path,
+    )
 
 
 if __name__ == "__main__":

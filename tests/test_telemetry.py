@@ -1,0 +1,419 @@
+"""Unified telemetry plane: recorder, wire format, job timeline, straggler
+attribution, and the metrics exposition."""
+
+import pickle
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common import telemetry
+from dlrover_tpu.common.telemetry import (
+    TelemetryRecorder,
+    events_to_chrome_trace,
+)
+from dlrover_tpu.master import messages as msg
+from dlrover_tpu.master.diagnosis import (
+    ActionType,
+    DiagnosisContext,
+    InferenceChain,
+    StragglerOperator,
+)
+from dlrover_tpu.master.metrics import MetricsCollector
+from dlrover_tpu.master.node_manager import NodeManager
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.timeline import JobTimeline
+
+
+def _recorder(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("ring_size", 256)
+    return TelemetryRecorder(**kw)
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_span_nesting_and_attrs():
+    r = _recorder(source="trainer")
+    with r.span("outer", step=7):
+        with r.span("inner", piece="a"):
+            pass
+    events = r.drain()
+    # Inner exits (and records) first; both carry their attrs + src.
+    assert [e[0] for e in events] == ["inner", "outer"]
+    inner, outer = events
+    assert inner[1] == "span" and inner[4]["piece"] == "a"
+    assert outer[4]["step"] == 7
+    assert inner[4]["src"] == outer[4]["src"] == "trainer"
+    assert outer[3] >= inner[3] >= 0.0  # outer duration covers inner
+
+
+def test_span_attrs_mutable_mid_span():
+    r = _recorder()
+    with r.span("rendezvous") as sp:
+        sp.attrs["round"] = 3
+    (event,) = r.drain()
+    assert event[4]["round"] == 3
+
+
+def test_span_records_error_kind_and_reraises():
+    r = _recorder()
+    with pytest.raises(ValueError):
+        with r.span("step"):
+            raise ValueError("boom")
+    (event,) = r.drain()
+    assert event[4]["error"] == "ValueError"
+
+
+def test_event_duration_selects_kind():
+    r = _recorder()
+    r.event("restart")
+    r.event("compile", duration_s=1.5)
+    instant, timed = r.drain()
+    assert instant[1] == "event" and instant[3] == 0.0
+    assert timed[1] == "span" and timed[3] == 1.5
+
+
+def test_wall_clock_anchor():
+    r = _recorder()
+    r.event("tick")
+    (event,) = r.drain()
+    assert abs(event[2] - time.time()) < 5.0
+
+
+def test_ring_bounded_under_threaded_churn():
+    r = _recorder(ring_size=64)
+
+    def hammer():
+        for i in range(500):
+            r.event("spin", i=i)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(r) == 64
+    assert r.dropped == 4 * 500 - 64
+    assert r.drain() and len(r) == 0
+
+
+def test_disabled_mode_allocates_nothing_per_event():
+    r = _recorder(enabled=False)
+    # span() hands out ONE cached null context — identity, not equality:
+    # the disabled hot path must not allocate per call.
+    assert r.span("a", x=1) is r.span("b") is telemetry._NULL_SPAN
+    r.event("a", duration_s=2.0, x=1)
+    with r.span("c"):
+        pass
+    assert len(r) == 0 and r.drain() == []
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv(telemetry.ENV_ENABLE, "off")
+    monkeypatch.setenv(telemetry.ENV_RING, "128")
+    r = TelemetryRecorder()
+    assert not r.enabled and r.ring_size == 128
+    monkeypatch.setenv(telemetry.ENV_ENABLE, "1")
+    assert TelemetryRecorder().enabled
+
+
+def test_configure_resizes_preserving_newest():
+    r = _recorder(ring_size=64)
+    for i in range(64):
+        r.event("e", i=i)
+    r.configure(ring_size=16)
+    kept = [e[4]["i"] for e in r.drain()]
+    assert kept == list(range(48, 64))
+
+
+class _FakeClient:
+    def __init__(self):
+        self.batches = []
+
+    def report_telemetry(self, events, dropped=0):
+        self.batches.append((list(events), dropped))
+
+
+def test_ship_drains_events_and_dropped():
+    r = _recorder(ring_size=16)
+    client = _FakeClient()
+    assert r.ship(client) == 0 and client.batches == []  # empty: no RPC
+    for i in range(20):
+        r.event("e", i=i)
+    assert r.ship(client) == 16
+    events, dropped = client.batches[0]
+    assert len(events) == 16 and dropped == 4
+    assert r.dropped == 0 and len(r) == 0
+
+
+# -- wire round-trip through the servicer ------------------------------------
+
+
+def test_wire_round_trip_through_servicer():
+    """Trainer + agent recorders drain through pickled TelemetryEvents into
+    a real servicer; the merged timeline holds both tiers' streams (the
+    PR's acceptance shape: step/compile spans AND rendezvous/restart)."""
+    trainer = _recorder(source="trainer")
+    with trainer.span("step", step=1):
+        pass
+    trainer.event("compile", duration_s=2.5, cached=False)
+    agent = _recorder(source="agent")
+    with agent.span("rendezvous") as sp:
+        sp.attrs["round"] = 0
+    agent.event("restart", restart_count=1)
+
+    timeline = JobTimeline()
+    servicer = MasterServicer(timeline=timeline)
+    for recorder in (trainer, agent):
+        wire = pickle.dumps(msg.Envelope(
+            node_id=5,
+            payload=msg.TelemetryEvents(5, tuple(recorder.drain())),
+        ))
+        response = servicer.report(msg.safe_loads(wire))
+        assert response.success, response.message
+
+    names = {e[0] for e in timeline.events(5)[5]}
+    assert {"step", "compile", "rendezvous", "restart"} <= names
+    assert timeline.restart_count(5) == 1
+    assert [e[3] for e in timeline.spans(5, "compile")] == [2.5]
+    # src lanes survived the merge.
+    sources = {e[4]["src"] for e in timeline.events(5)[5]}
+    assert sources == {"trainer", "agent"}
+
+
+def test_servicer_timeline_and_metrics_requests():
+    timeline = JobTimeline()
+    timeline.record(0, "step", kind="span", duration_s=0.1,
+                    attrs={"step": 1})
+    servicer = MasterServicer(
+        speed_monitor=SpeedMonitor(), timeline=timeline
+    )
+    got = servicer.get(msg.Envelope(payload=msg.TimelineRequest()))
+    assert got.success and 0 in got.payload
+    text = servicer.get(msg.Envelope(payload=msg.MetricsRequest()))
+    assert text.success and "dlrover_goodput" in text.payload
+    # No timeline wired -> degrade, don't fail.
+    bare = MasterServicer()
+    assert bare.get(msg.Envelope(payload=msg.MetricsRequest())).payload == ""
+
+
+def test_malformed_wire_events_do_not_drop_batch():
+    timeline = JobTimeline()
+    timeline.add_events(0, [
+        ("good", "event", 0.0, 0.0, {}),
+        "garbage",
+        ("short",),
+        ("also-good", "span", 1.0, 0.5, {"k": 1}),
+    ])
+    assert [e[0] for e in timeline.events(0)[0]] == ["good", "also-good"]
+
+
+# -- chrome trace ------------------------------------------------------------
+
+
+def test_chrome_trace_tracks_per_node_and_source():
+    events = {
+        0: [("step", "span", 10.0, 0.25, {"src": "trainer", "step": 1}),
+            ("restart", "event", 11.0, 0.0, {"src": "agent"})],
+        1: [("step", "span", 10.1, 0.30, {"src": "trainer", "step": 1})],
+    }
+    trace = events_to_chrome_trace(events)["traceEvents"]
+    slices = [e for e in trace if e["ph"] == "X"]
+    instants = [e for e in trace if e["ph"] == "i"]
+    assert {e["pid"] for e in slices} == {0, 1}
+    assert instants[0]["pid"] == 0
+    # trainer and agent get distinct thread lanes within node 0.
+    node0 = {e["tid"] for e in trace if e["pid"] == 0 and e["ph"] != "M"}
+    assert len(node0) == 2
+    step = next(e for e in slices if e["pid"] == 0)
+    assert step["dur"] == pytest.approx(0.25e6)
+    assert step["args"]["step"] == 1 and "src" not in step["args"]
+    names = [e for e in trace if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in names)
+    assert any(e["args"].get("name") == "agent" for e in names)
+
+
+# -- skew attribution + straggler operator -----------------------------------
+
+
+def _skewed_timeline(nodes=3, steps=12, slow_node=2, ratio=3.0):
+    timeline = JobTimeline()
+    for step in range(steps):
+        for node in range(nodes):
+            duration = 0.1 * ratio if node == slow_node else 0.1
+            timeline.record(node, "step", kind="span", duration_s=duration,
+                            attrs={"step": step})
+    return timeline
+
+
+def test_step_stats_and_slowest_histogram():
+    timeline = _skewed_timeline()
+    stats = timeline.step_stats()
+    assert stats[2]["p50"] == pytest.approx(0.3)
+    assert stats[0]["p95"] == pytest.approx(0.1)
+    assert timeline.slowest_per_step() == {2: 12}
+    assert timeline.steps_observed() == 12
+    assert timeline.step_skew(2.0) == {2: 12}
+
+
+def test_straggler_operator_reports_slow_node():
+    ctx = DiagnosisContext(
+        speed_monitor=SpeedMonitor(), metrics=None, node_manager=None,
+        timeline=_skewed_timeline(),
+    )
+    actions = StragglerOperator().observe(ctx)
+    assert len(actions) == 1
+    action = actions[0]
+    assert action.action == ActionType.REPORT
+    assert action.node_id == 2
+    assert "node 2" in action.reason and "straggler" in action.reason
+
+
+def test_straggler_balanced_world_stays_quiet():
+    timeline = JobTimeline()
+    for step in range(20):
+        for node in range(3):
+            timeline.record(node, "step", kind="span",
+                            duration_s=0.1 + 0.001 * node,
+                            attrs={"step": step})
+    ctx = DiagnosisContext(
+        speed_monitor=SpeedMonitor(), metrics=None, node_manager=None,
+        timeline=timeline,
+    )
+    assert StragglerOperator().observe(ctx) == []
+    # And absent/None timeline disables the rule instead of raising.
+    ctx.timeline = None
+    assert StragglerOperator().observe(ctx) == []
+
+
+def test_straggler_needs_persistent_evidence():
+    # Below MIN_STEPS multi-node steps: no verdict yet.
+    ctx = DiagnosisContext(
+        speed_monitor=SpeedMonitor(), metrics=None, node_manager=None,
+        timeline=_skewed_timeline(steps=StragglerOperator.MIN_STEPS - 1),
+    )
+    assert StragglerOperator().observe(ctx) == []
+
+
+def test_straggler_registered_in_default_chain():
+    assert any(
+        isinstance(op, StragglerOperator)
+        for op in InferenceChain().operators
+    )
+
+
+# -- metrics exposition ------------------------------------------------------
+
+
+def test_render_metrics_goodput_matches_speed_monitor():
+    sm = SpeedMonitor()
+    now = time.time()
+    for i in range(10):
+        sm.collect_global_step(i + 1, now - (10 - i) * 1.0, tokens=100)
+    sm.record_compile(4.2, restart=True)
+    sm.record_anomaly(5, "nan@5:loss=nan")
+    sm.record_anomaly(6, "loss_spike@6:loss=9.0")
+    timeline = _skewed_timeline()
+    text = timeline.render_metrics(speed_monitor=sm)
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            metrics[key] = float(value)
+    # Acceptance: exposition goodput within 1% of the ledger's own value.
+    assert metrics["dlrover_goodput"] == pytest.approx(
+        sm.goodput(), abs=0.01
+    )
+    assert metrics["dlrover_global_step"] == 10
+    assert metrics["dlrover_compile_seconds_total"] == pytest.approx(4.2)
+    assert metrics["dlrover_restart_compile_seconds_total"] == (
+        pytest.approx(4.2)
+    )
+    assert metrics['dlrover_numeric_anomalies_recent{kind="nan"}'] == 1
+    assert (
+        metrics['dlrover_numeric_anomalies_recent{kind="loss_spike"}'] == 1
+    )
+    assert metrics['dlrover_step_time_seconds{node="2",quantile="0.50"}'] \
+        == pytest.approx(0.3)
+    assert metrics['dlrover_slowest_steps_total{node="2"}'] == 12
+
+
+def test_render_metrics_includes_node_manager_relaunches():
+    timeline = JobTimeline()
+    nm = NodeManager(num_nodes=2)
+    nm._nodes[1].relaunch_count = 2
+    text = timeline.render_metrics(node_manager=nm)
+    assert 'dlrover_node_relaunch_count{node="1"} 2' in text
+
+
+# -- eviction ----------------------------------------------------------------
+
+
+def test_metrics_collector_evict():
+    metrics = MetricsCollector()
+    metrics.collect(0, 10.0, 1.0)
+    metrics.collect(1, 90.0, 2.0, timestamp=time.time() - 1000)
+    metrics.evict(1)
+    assert metrics.latest(1) is None
+    assert metrics.nodes() == [0]
+    assert metrics.stale_nodes(300.0) == []
+    metrics.evict(7)  # unknown node: no-op
+
+
+def test_timeline_evict_node():
+    timeline = _skewed_timeline()
+    timeline.record(2, "restart")
+    timeline.evict_node(2)
+    assert timeline.nodes() == [0, 1]
+    assert timeline.restart_count(2) == 0
+    assert 2 not in timeline.step_skew(2.0)
+    assert 2 not in timeline.step_stats()
+
+
+def test_scale_down_evicts_observability_series():
+    """Regression: a node_manager-driven departure (retire) must drop the
+    node's metrics + timeline series via the master's transition hook."""
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(num_nodes=2, auto_scale=False)
+    master.metrics.collect(1, 50.0, 4.0)
+    master.timeline.record(1, "step", kind="span", duration_s=0.1,
+                           attrs={"step": 3})
+    assert master.metrics.latest(1) and master.timeline.nodes() == [1]
+    master.node_manager.retire_node(1)
+    assert master.metrics.latest(1) is None
+    assert master.timeline.nodes() == []
+    # The scaler's retire hook path clears series the same way.
+    master.metrics.collect(0, 10.0, 1.0)
+    master.timeline.record(0, "step", kind="span", duration_s=0.1,
+                           attrs={"step": 4})
+    master._handle_node_retired(0)
+    assert master.metrics.latest(0) is None
+    assert master.timeline.nodes() == []
+
+
+# -- pipeline-counter folding ------------------------------------------------
+
+
+def test_host_blocks_fold_into_module_recorder():
+    from dlrover_tpu.utils.profiler import pipeline_counters
+
+    recorder = telemetry.recorder()
+    was_enabled = recorder.enabled
+    recorder.configure(enabled=True)
+    recorder.drain()
+    try:
+        with pipeline_counters().host_block("metrics-flush", steps=(3, 4)):
+            pass
+        pipeline_counters().record_place(0.002)
+        events = recorder.drain()
+    finally:
+        recorder.configure(enabled=was_enabled)
+    names = [e[0] for e in events]
+    assert "metrics-flush" in names and "h2d" in names
+    flush = events[names.index("metrics-flush")]
+    assert flush[1] == "span" and flush[4]["steps"] == (3, 4)
+    assert flush[4]["kind"] == "block"

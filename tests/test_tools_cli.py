@@ -1,0 +1,100 @@
+"""CI smoke for the tools/ scripts + the timeline dump path end-to-end.
+
+Every ``tools/*.py`` must stay importable (their ``__main__`` guards keep
+import side-effect-free), ``tools/job_timeline.py`` must answer ``--help``
+as a subprocess, and ``examples/train_lm.py --timeline`` must write a
+loadable Chrome trace from a real (tiny, CPU) training run.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_module(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted(glob.glob(os.path.join(REPO, "tools", "*.py"))),
+    ids=lambda p: os.path.basename(p),
+)
+def test_tools_smoke_import(path):
+    """Importing a tool must execute no work (main() is guarded)."""
+    _load_module(path, f"_tool_{os.path.basename(path)[:-3]}")
+
+
+def test_job_timeline_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "job_timeline.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--master" in out.stdout and "--out" in out.stdout
+
+
+def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):
+    events = {
+        "0": [["step", "span", 10.0, 0.2, {"src": "trainer", "step": 1}],
+              ["restart", "event", 11.0, 0.0, {"src": "agent"}]],
+        "1": [["step", "span", 10.05, 0.21, {"src": "trainer", "step": 1}]],
+    }
+    src = tmp_path / "events.json"
+    src.write_text(json.dumps(events))
+    out = tmp_path / "trace.json"
+    tool = _load_module(
+        os.path.join(REPO, "tools", "job_timeline.py"), "_job_timeline"
+    )
+    monkeypatch.setattr(sys, "argv", [
+        "job_timeline.py", "--input", str(src), "--out", str(out),
+    ])
+    assert tool.main() == 0
+    trace = json.loads(out.read_text())
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in slices} == {0, 1}
+    assert any(e["ph"] == "i" for e in trace["traceEvents"])
+
+
+def test_train_lm_timeline_flag(tmp_path, monkeypatch):
+    """The example's ``--timeline`` writes a Chrome trace holding the run's
+    step spans (standalone mode: the local ring is the source)."""
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    try:
+        import train_lm
+    finally:
+        sys.path.pop(0)
+    from dlrover_tpu.common import telemetry
+
+    recorder = telemetry.recorder()
+    was_enabled = recorder.enabled
+    recorder.configure(enabled=True)
+    recorder.drain()
+    out = tmp_path / "trace.json"
+    monkeypatch.setattr(sys, "argv", [
+        "train_lm.py", "--steps", "3", "--layers", "1", "--d-model", "32",
+        "--heads", "2", "--vocab", "64", "--seq-len", "16",
+        "--batch-size", "8", "--timeline", str(out),
+    ])
+    try:
+        assert train_lm.main() == 0
+    finally:
+        recorder.configure(enabled=was_enabled)
+    trace = json.loads(out.read_text())
+    steps = [
+        e for e in trace["traceEvents"]
+        if e.get("name") == "step" and e["ph"] == "X"
+    ]
+    assert len(steps) == 3
+    assert sorted(e["args"]["step"] for e in steps) == [1, 2, 3]

@@ -1,0 +1,109 @@
+"""Dump the merged job timeline as a Perfetto/Chrome-trace JSON file.
+
+Fetches every node's telemetry stream from a live master (``--master``)
+or reads a previously-saved wire-event dump (``--input``), converts it
+with ``common/telemetry.events_to_chrome_trace`` — one trace process per
+node, one thread per recording tier (trainer/agent) — and writes a file
+that loads directly at https://ui.perfetto.dev or ``chrome://tracing``.
+
+Usage::
+
+    python tools/job_timeline.py --master localhost:12345 --out trace.json
+    python tools/job_timeline.py --input events.json --out trace.json
+    python tools/job_timeline.py --master localhost:12345 --metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def load_events(master: str = "", input_path: str = "") -> dict:
+    """{node_id: [wire event, ...]} from a master or a JSON dump.
+
+    Heavy imports (grpc via MasterClient) stay inside so ``--help`` and
+    file conversion never pay for them.
+    """
+    if master:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(master)
+        try:
+            events = client.get_timeline()
+        finally:
+            client.close()
+        return {int(n): list(evs) for n, evs in events.items()}
+    with open(input_path) as f:
+        raw = json.load(f)
+    return {int(n): list(evs) for n, evs in raw.items()}
+
+
+def fetch_metrics(master: str) -> str:
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    client = MasterClient(master)
+    try:
+        return client.get_metrics_text()
+    finally:
+        client.close()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    source = p.add_mutually_exclusive_group(required=True)
+    source.add_argument(
+        "--master", default="",
+        help="master address host:port to fetch the live timeline from",
+    )
+    source.add_argument(
+        "--input", default="",
+        help="JSON file of wire events {node_id: [event, ...]} "
+        "(e.g. examples/train_lm.py --timeline output is already a "
+        "Chrome trace; this flag is for raw get_timeline dumps)",
+    )
+    p.add_argument(
+        "--out", default="job_timeline.json",
+        help="output Chrome-trace path (default: %(default)s)",
+    )
+    p.add_argument(
+        "--raw", default="",
+        help="also save the raw wire events to this path (re-convertible "
+        "later via --input)",
+    )
+    p.add_argument(
+        "--metrics", action="store_true",
+        help="print the master's Prometheus-style exposition instead of "
+        "writing a trace (requires --master)",
+    )
+    args = p.parse_args()
+    if args.metrics:
+        if not args.master:
+            p.error("--metrics requires --master")
+        print(fetch_metrics(args.master), end="")
+        return 0
+    events = load_events(master=args.master, input_path=args.input)
+    if args.raw:
+        with open(args.raw, "w") as f:
+            json.dump({str(n): evs for n, evs in events.items()}, f)
+    from dlrover_tpu.common.telemetry import events_to_chrome_trace
+
+    trace = events_to_chrome_trace(events)
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    total = sum(len(evs) for evs in events.values())
+    print(
+        f"wrote {args.out}: {total} events across "
+        f"{len(events)} node(s) — open at https://ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

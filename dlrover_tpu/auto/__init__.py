@@ -1,1 +1,6 @@
-from dlrover_tpu.auto.tune import TuneResult, auto_tune  # noqa: F401
+from dlrover_tpu.auto.tune import (  # noqa: F401
+    TuneResult,
+    auto_tune,
+    est_comm_time,
+    pick_grad_accum,
+)

@@ -473,6 +473,90 @@ def _estimate(
     ) * bubble
 
 
+def pick_grad_accum(
+    config: TransformerConfig,
+    parallel: ParallelConfig,
+    global_batch_size: int,
+    seq_len: int,
+    *,
+    remat: str = "none",
+    optimizer: str = "adamw",
+    accum_dtype: str = "float32",
+    hbm_bytes: Optional[float] = None,
+) -> int:
+    """Smallest grad_accum N whose per-microbatch footprint fits HBM.
+
+    Same memory model as ``_estimate``, split by what N divides: the
+    activation/working/logits bytes scale with the microbatch (1/N) while
+    params/grads/optimizer don't — and accumulation ADDS one params-sized
+    accumulator (4 B/param fp32, 2 B bf16, sharded like the grads), so
+    N=1 with no accumulator must also be priced (it wins whenever the
+    full batch already fits).  Candidate Ns are the feasible divisors of
+    the per-dp-shard batch, walked smallest-first; when nothing fits the
+    largest feasible N is returned (the best the knob can do — the caller
+    sees the estimate and can shrink the model or batch).
+    """
+    _, _, hbm_default, _ = chip_specs()
+    hbm = hbm_bytes if hbm_bytes is not None else hbm_default
+    policy = remat_policy_lib.resolve(remat)
+    p = parallel
+    n = config.num_params()
+    shard = p.fsdp * p.tensor * p.pipe * max(p.expert, 1)
+    dp = max(p.data * p.fsdp, 1)
+    opt_mult = {"adamw": 8.0, "adafactor": 0.2, "q8_adam": 2.2,
+                "q4_adam": 1.25, "sgd": 4.0, "lion": 4.0}.get(optimizer, 8.0)
+    fixed_b = n * (2 + 2 + opt_mult) / shard  # params + grads + optimizer
+    accum_b = n * (2 if accum_dtype in ("bf16", "bfloat16") else 4) / shard
+    tokens_local = (
+        global_batch_size * seq_len / dp / max(p.seq, 1)
+    )
+    act_b = (
+        tokens_local * config.num_layers * config.d_model * 2
+        * policy.hbm_act_per_token_layer
+        / max(p.tensor, 1) / max(p.pipe, 1)
+    )
+    work_b = tokens_local * config.resolved_d_ff * 2 * 4 / max(p.tensor, 1)
+    logits_b = tokens_local * config.vocab_size * 4 / max(p.tensor, 1)
+    per_shard_rows = max(1, global_batch_size // dp)
+    feasible = [
+        N for N in range(1, per_shard_rows + 1)
+        if global_batch_size % (dp * N) == 0
+    ] or [1]
+    for N in feasible:
+        extra = accum_b if N > 1 else 0.0
+        total = (fixed_b + extra + (act_b + work_b + logits_b) / N) * 1.15
+        if total <= hbm * 0.92:
+            return N
+    return feasible[-1]
+
+
+def est_comm_time(
+    config: TransformerConfig,
+    parallel: ParallelConfig,
+    reduce_quant: str = "none",
+) -> float:
+    """Seconds for the once-per-step data-parallel gradient reduce.
+
+    Prices the microbatch engine's deferred reduce on both wire formats
+    with ``_estimate``'s constants: full-precision bf16 ring all-reduce
+    bytes ``2·n·2/shard·(dp-1)/dp`` over ICI; ``"int8"`` divides the wire
+    bytes by ~3.5 (int8 payload + fp32 block scales vs bf16, the
+    quantized_dcn folding) but pays ~3 extra HBM sweeps over the sharded
+    gradient tree for the quantize/dequantize passes.  Zero when data=1:
+    there is no reduce to price.
+    """
+    _, hbm_bw, _, ici_bw = chip_specs()
+    p = parallel
+    if p.data <= 1:
+        return 0.0
+    n = config.num_params()
+    shard = p.fsdp * p.tensor * p.pipe * max(p.expert, 1)
+    wire_b = 2 * n * 2 / shard * (p.data - 1) / p.data
+    if reduce_quant == "int8":
+        return wire_b / 3.5 / ici_bw + 3 * (n * 2 / shard) / hbm_bw
+    return wire_b / ici_bw
+
+
 def _measure(
     cand: Candidate,
     config: TransformerConfig,

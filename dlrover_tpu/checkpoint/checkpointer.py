@@ -75,6 +75,12 @@ class Checkpointer:
             treedef = jax.tree_util.tree_structure(state_template)
         return self._engine.load(shardings=shardings, treedef=treedef)
 
+    @property
+    def last_extra(self) -> Dict[str, Any]:
+        """The ``extra`` sidecar restored by the latest ``load_checkpoint``
+        ({} when nothing restored or the checkpoint carried none)."""
+        return dict(getattr(self._engine, "last_restored_extra", {}) or {})
+
     def wait(self, timeout: float = 600.0) -> bool:
         """Block until async persists drained (call before clean job exit)."""
         return self._engine.wait_saver(timeout)

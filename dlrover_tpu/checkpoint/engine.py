@@ -132,6 +132,8 @@ class CheckpointEngine:
         self._status = SharedDict(status_name(self.host_index), create=False)
         self._latest_memory_step = -1
         self._latest_storage_step = -1
+        # ``extra`` sidecar of the most recently restored checkpoint.
+        self.last_restored_extra: Dict[str, Any] = {}
 
     # -- save -----------------------------------------------------------------
 
@@ -408,6 +410,10 @@ class CheckpointEngine:
         return all(t.local_covers_global for t in meta.tensors)
 
     def _materialize(self, arrays, meta, shardings, treedef):
+        # Surface the checkpoint's small non-array sidecar to the caller
+        # (trainer knob booking: grad_accum/reference world, rng, config)
+        # without widening every load path's (step, state) return.
+        self.last_restored_extra = dict(getattr(meta, "extra", None) or {})
         if treedef is None:
             return arrays
         ordered = [arrays[t.path] for t in meta.tensors]

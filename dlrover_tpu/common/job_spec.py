@@ -201,7 +201,13 @@ def load_job_spec(path: str) -> ElasticJobSpec:
     with open(path, "rb") as f:
         raw = f.read()
     if ext == ".toml":
-        import tomllib
+        try:
+            import tomllib
+        except ModuleNotFoundError:  # Python < 3.11
+            try:
+                import tomli as tomllib  # type: ignore[no-redef]
+            except ModuleNotFoundError:
+                from pip._vendor import tomli as tomllib  # type: ignore
 
         data = tomllib.loads(raw.decode())
     elif ext in (".yaml", ".yml"):

@@ -157,12 +157,21 @@ class TelemetryRecorder:
             return _NULL_SPAN
         return _Span(self, name, attrs)
 
-    def event(self, name: str, duration_s: float = 0.0, **attrs):
-        """Record an instant (or externally-timed) occurrence."""
+    def event(self, name: str, duration_s: float = 0.0,
+              t_mono: Optional[float] = None, **attrs):
+        """Record an instant (or externally-timed) occurrence.
+
+        ``t_mono`` backdates the event to a caller-captured
+        ``time.monotonic()`` reading — how modeled sub-phases (e.g. the
+        microbatch engine's accumulate/reduce/update breakdown, which the
+        host cannot observe inside one XLA program) are placed *inside*
+        their enclosing measured span on the Chrome trace.
+        """
         if not self.enabled:
             return
         self._record("event" if duration_s == 0.0 else "span",
-                     name, time.monotonic(), duration_s, attrs)
+                     name, time.monotonic() if t_mono is None else t_mono,
+                     duration_s, attrs)
 
     # -- shipping -------------------------------------------------------------
 
@@ -253,8 +262,9 @@ def span(name: str, **attrs):
     return _RECORDER.span(name, **attrs)
 
 
-def event(name: str, duration_s: float = 0.0, **attrs):
-    _RECORDER.event(name, duration_s=duration_s, **attrs)
+def event(name: str, duration_s: float = 0.0,
+          t_mono: Optional[float] = None, **attrs):
+    _RECORDER.event(name, duration_s=duration_s, t_mono=t_mono, **attrs)
 
 
 def configure(**kwargs):

@@ -87,13 +87,19 @@ def train_cache_key(
     seq_len: int,
     ce_chunks: int = 0,
     optimizer: str = "",
+    grad_accum: int = 1,
+    accum_dtype: str = "float32",
+    reduce_quant: str = "none",
 ) -> str:
     """Name the compiled train program by everything that shapes it.
 
     Two trainers with equal keys compile byte-identical programs: the
     model config dataclass fields, the mesh axis sizes (shape, not device
     objects — a restart's fresh Mesh over the same devices must hit), the
-    batch geometry, and the optimizer recipe.
+    batch geometry, the optimizer recipe, and the microbatch-engine knobs
+    (grad_accum reshapes the whole step program; accum_dtype/reduce_quant
+    change the accumulator and reduce lowering — aliasing any of them
+    would hand a resized world the wrong executable).
     """
     fields = tuple(sorted(
         (k, repr(v)) for k, v in vars(model_config).items()
@@ -101,4 +107,5 @@ def train_cache_key(
     return repr((
         type(model_config).__name__, fields, tuple(mesh_shape),
         global_batch_size, seq_len, ce_chunks, optimizer,
+        grad_accum, accum_dtype, reduce_quant,
     ))

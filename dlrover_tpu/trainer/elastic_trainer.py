@@ -78,6 +78,25 @@ class TrainerConfig:
     # Persistent XLA compilation cache directory; "" resolves the
     # DLROVER_TPU_COMPILE_CACHE env knob, then checkpoint_dir/compile_cache.
     compile_cache_dir: str = ""
+    # -- microbatch engine --------------------------------------------------
+    # Gradient accumulation: split the global batch into N microbatches
+    # and lax.scan the fwd+bwd, accumulating grads on device
+    # (train_lib.build_sharded_train).  Tokens/step is invariant in N; on
+    # an elastic resize the effective N is recomputed from the reference
+    # world below, so fewer chips -> more microbatches at ~constant
+    # per-device activation HBM, same optimizer trajectory.
+    grad_accum: int = 1
+    # Accumulator dtype: "float32" (default; exact parity with the
+    # full-batch step) or "bf16" (half the accumulator HBM; tolerance
+    # documented in PROFILE.md).
+    accum_dtype: str = "float32"
+    # "int8" routes the deferred once-per-step DP gradient reduce through
+    # the EQuARX-style quantized all-reduce; "none" = XLA's fp reduce.
+    reduce_quant: str = "none"
+    # World size ``grad_accum`` was chosen for; 0 = the world at first
+    # construction.  Booked in checkpoint `extra` so a restore into a
+    # different world recomputes N from the ORIGINAL reference pairing.
+    grad_accum_ref_world: int = 0
 
 
 class TrainerCallback:
@@ -178,30 +197,30 @@ class ElasticTrainer:
         compile_cache.maybe_enable(
             config.compile_cache_dir, workdir=config.checkpoint_dir
         )
+        # Microbatch engine: resolve the effective grad_accum for THIS
+        # world from the configured reference pairing (config.grad_accum @
+        # grad_accum_ref_world, default: the current world), snapped to a
+        # feasible divisor of the batch sharding.
+        self._rules = rules if rules is not None else lr.DEFAULT_RULES
+        self._world = max(1, jax.device_count())
+        self._ref_accum = max(1, config.grad_accum)
+        self._ref_world = config.grad_accum_ref_world or self._world
+        self.grad_accum = self._resolve_grad_accum()
+        if self.grad_accum != self._ref_accum:
+            logger.info(
+                "elastic grad_accum: %d (reference %d @ world %d -> world "
+                "%d; tokens/step unchanged at %d)",
+                self.grad_accum, self._ref_accum, self._ref_world,
+                self._world,
+                config.global_batch_size * config.seq_len,
+            )
         # Layer 2: in-process program reuse.  Only config-built pieces are
         # representable in the key — a caller-supplied optimizer or rule
         # set could close over anything, so either one opts out.
-        cache_key = None
-        if config.reuse_compiled and optimizer is None and rules is None:
-            cache_key = compile_cache.train_cache_key(
-                model_config, self.mesh.devices.shape,
-                global_batch_size=config.global_batch_size,
-                seq_len=config.seq_len,
-                ce_chunks=config.ce_chunks,
-                optimizer=(
-                    f"{config.optimizer}/lr={config.learning_rate!r}"
-                    f"/warmup={config.warmup_steps}"
-                    f"/decay={config.decay_steps}"
-                ),
-            )
-        self.train = train_lib.build_sharded_train(
-            self.model, self.optimizer, self.mesh,
-            rules if rules is not None else lr.DEFAULT_RULES,
-            global_batch_size=config.global_batch_size,
-            seq_len=config.seq_len,
-            ce_chunks=config.ce_chunks,
-            cache_key=cache_key,
+        self._cacheable = (
+            config.reuse_compiled and optimizer is None and rules is None
         )
+        self.train = self._build_train()
         if config.warmup_compile:
             compile_s = self.train.aot_compile()
             # 0.0 means the build cache handed back an already-compiled
@@ -242,6 +261,96 @@ class ElasticTrainer:
                 logger.info(
                     "resumed from checkpoint at step %d", restored_step
                 )
+                self._adopt_checkpoint_accum(self._ckpt.last_extra)
+
+    # -- microbatch engine -----------------------------------------------------
+
+    def _dp_shards(self) -> int:
+        """How many ways the batch dim splits on this mesh + rule table."""
+        spec = train_lib.logical_sharding(
+            self.mesh, self._rules, lr.BATCH
+        ).spec
+        return train_lib._batch_shard_count(
+            self.mesh, spec[0] if spec else None
+        )
+
+    def _resolve_grad_accum(self) -> int:
+        return train_lib.elastic_grad_accum(
+            self._ref_accum, self._ref_world, self._world,
+            self.config.global_batch_size, self._dp_shards(),
+        )
+
+    def _build_train(self) -> train_lib.ShardedTrain:
+        config = self.config
+        cache_key = None
+        if self._cacheable:
+            cache_key = compile_cache.train_cache_key(
+                self.model_config, self.mesh.devices.shape,
+                global_batch_size=config.global_batch_size,
+                seq_len=config.seq_len,
+                ce_chunks=config.ce_chunks,
+                optimizer=(
+                    f"{config.optimizer}/lr={config.learning_rate!r}"
+                    f"/warmup={config.warmup_steps}"
+                    f"/decay={config.decay_steps}"
+                ),
+                grad_accum=self.grad_accum,
+                accum_dtype=config.accum_dtype,
+                reduce_quant=config.reduce_quant,
+            )
+        return train_lib.build_sharded_train(
+            self.model, self.optimizer, self.mesh, self._rules,
+            global_batch_size=config.global_batch_size,
+            seq_len=config.seq_len,
+            ce_chunks=config.ce_chunks,
+            grad_accum=self.grad_accum,
+            accum_dtype=config.accum_dtype,
+            reduce_quant=config.reduce_quant,
+            cache_key=cache_key,
+        )
+
+    def _accum_extra(self) -> Dict[str, Any]:
+        """The microbatch-engine sidecar booked with every checkpoint."""
+        return {
+            "grad_accum": self.grad_accum,
+            "grad_accum_ref": {
+                "accum": self._ref_accum, "world": self._ref_world,
+            },
+            "accum_dtype": self.config.accum_dtype,
+            "reduce_quant": self.config.reduce_quant,
+            "global_batch_size": self.config.global_batch_size,
+            "world": self._world,
+        }
+
+    def _adopt_checkpoint_accum(self, extra: Dict[str, Any]):
+        """Recompute grad_accum from the checkpoint's booked reference.
+
+        The checkpoint carries the ORIGINAL (accum, world) pairing the run
+        was launched with; a restore into a resized world derives N from
+        that booking — not from whatever this process's config says — so
+        every restart of the job lands on the same tokens/step-invariant
+        schedule.  A changed N rebuilds the compiled program (state
+        shardings are N-independent, so the restored state stays placed).
+        """
+        ref = extra.get("grad_accum_ref") if extra else None
+        if not ref:
+            return
+        booked = (int(ref.get("accum", 1)), int(ref.get("world", 0)))
+        if booked[1] <= 0:
+            return
+        if booked == (self._ref_accum, self._ref_world):
+            return
+        self._ref_accum, self._ref_world = booked
+        resolved = self._resolve_grad_accum()
+        if resolved == self.grad_accum:
+            return
+        logger.info(
+            "checkpoint booked grad_accum reference %d @ world %d -> "
+            "rebuilding with grad_accum=%d for world %d",
+            booked[0], booked[1], resolved, self._world,
+        )
+        self.grad_accum = resolved
+        self.train = self._build_train()
 
     # -- loop -----------------------------------------------------------------
 
@@ -250,6 +359,7 @@ class ElasticTrainer:
         # dispatch, plus any backpressure XLA applies when the device falls
         # behind — exactly the per-node signal the master's step-skew
         # attribution compares across hosts.
+        t_span = time.monotonic()
         with telemetry.span("step", step=self.step + 1):
             placed = train_lib.shard_batch(batch, self.train)
             t0 = time.perf_counter()
@@ -258,6 +368,20 @@ class ElasticTrainer:
             pipeline_counters().record_dispatch(
                 self.step, time.perf_counter() - t0
             )
+        if self.train.grad_accum > 1 and telemetry.recorder().enabled:
+            # The accumulate/reduce/update phases live inside one XLA
+            # program, invisible to the host — emit the cost-model
+            # breakdown as sub-spans backdated into the measured step span
+            # (source="modeled") so the job timeline shows the overlap.
+            wall = time.monotonic() - t_span
+            for row in train_lib.microbatch_phase_plan(
+                self.train.grad_accum, self.train.reduce_quant, wall
+            ):
+                telemetry.event(
+                    row["phase"], duration_s=row["dur"],
+                    t_mono=t_span + row["t0"], step=self.step,
+                    micro=row["micro"], source="modeled",
+                )
         self._last_metrics = metrics
         return metrics
 
@@ -571,7 +695,8 @@ class ElasticTrainer:
 
         with telemetry.span("checkpoint", step=self.step):
             self._ckpt.save_checkpoint(
-                self.step, self.state, StorageType.DISK
+                self.step, self.state, StorageType.DISK,
+                extra=self._accum_extra(),
             )
         self._last_saved = self.step
         self._dispatch("on_checkpoint", self.step)

@@ -245,6 +245,11 @@ class ShardedTrain:
     # Abstract batch (ShapeDtypeStructs) matching step_fn's second arg —
     # what aot_compile lowers against without touching real data.
     batch_avals: Optional[Dict[str, jax.ShapeDtypeStruct]] = None
+    # Microbatch-engine knobs the program was built with (introspection for
+    # the trainer façade, trace tooling, and checkpoint `extra` booking).
+    grad_accum: int = 1
+    accum_dtype: str = "float32"
+    reduce_quant: str = "none"
     _aot_step: Optional[Callable] = None
 
     def init(self, rng: jax.Array) -> TrainState:
@@ -327,6 +332,29 @@ def reset_build_cache():
     _BUILD_CACHE.clear()
 
 
+_ACCUM_DTYPES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+}
+
+
+def _batch_shard_count(mesh: Mesh, batch_spec_entry) -> int:
+    """How many ways the batch dim is split (product of its mesh axes)."""
+    if batch_spec_entry is None:
+        return 1
+    names = (
+        batch_spec_entry
+        if isinstance(batch_spec_entry, tuple)
+        else (batch_spec_entry,)
+    )
+    out = 1
+    for name in names:
+        out *= dict(zip(mesh.axis_names, mesh.devices.shape))[name]
+    return out
+
+
 def build_sharded_train(
     model: nn.Module,
     optimizer: optax.GradientTransformation,
@@ -337,6 +365,9 @@ def build_sharded_train(
     seq_len: int,
     donate_state: bool = True,
     ce_chunks: int = 0,
+    grad_accum: int = 1,
+    accum_dtype: str = "float32",
+    reduce_quant: str = "none",
     cache_key: Optional[str] = None,
 ) -> ShardedTrain:
     """Construct init/step functions jitted with mesh shardings.
@@ -345,6 +376,28 @@ def build_sharded_train(
     shape [global_batch, seq_len] (plus optional fp ``weights``), laid out as
     jax.Arrays sharded batch-over-(data,fsdp) and seq-over-seq.
 
+    ``grad_accum=N`` turns on the microbatch engine: the global batch is
+    reshaped to [N, micro, seq] and a donated-carry ``lax.scan`` runs the
+    forward+backward once per microbatch, accumulating gradients into an
+    ``accum_dtype`` carry (fp32 default; "bf16" halves accumulator HBM at a
+    documented tolerance cost) pinned to the params' sharding with
+    ``with_sharding_constraint`` — XLA keeps the accumulator distributed
+    and defers the data-parallel reduce to once per step instead of once
+    per microbatch.  The loss is normalized by the GLOBAL token count (and
+    the model aux loss by 1/N), so the accumulated gradient equals the
+    full-batch gradient bitwise-up-to-reassociation: tokens/step and the
+    optimizer trajectory are invariant in N, which is what lets the elastic
+    trainer trade microbatches for devices on a resize.
+
+    ``reduce_quant="int8"`` routes the once-per-step deferred gradient
+    reduce through ``parallel.quantized_collectives.quantized_all_reduce``
+    (EQuARX-shaped int8 wire format) over the ``data`` mesh axis via
+    ``shard_map``.  Under GSPMD the per-microbatch grads arrive already
+    globally summed, so on the data axis this runs the real quantized
+    collective over data-replicated values — exercising the int8 wire path
+    (and its quantization rounding) inside the compiled program; with
+    ``data=1`` it is the identity.
+
     ``cache_key`` (from ``runtime.compile_cache.train_cache_key``) opts into
     the in-process program memo: the caller asserts that equal keys mean an
     identical (model, optimizer, mesh-shape, batch) recipe, and gets back
@@ -352,6 +405,17 @@ def build_sharded_train(
     compares mesh device layout too, so a resize to a genuinely different
     world never aliases.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if accum_dtype not in _ACCUM_DTYPES:
+        raise ValueError(
+            f"accum_dtype {accum_dtype!r} not in "
+            f"{sorted(_ACCUM_DTYPES)}"
+        )
+    if reduce_quant not in ("none", "int8"):
+        raise ValueError(
+            f"reduce_quant {reduce_quant!r} must be 'none' or 'int8'"
+        )
     if cache_key is not None:
         cached = _BUILD_CACHE.get(cache_key)
         if cached is not None and (
@@ -401,26 +465,45 @@ def build_sharded_train(
         "targets": token_sharding,
         "weights": token_sharding,
     }
+    if grad_accum > 1:
+        dp = _batch_shard_count(mesh, token_sharding.spec[0])
+        if global_batch_size % (dp * grad_accum):
+            raise ValueError(
+                f"global_batch_size {global_batch_size} must be divisible "
+                f"by dp*grad_accum = {dp}*{grad_accum} = {dp * grad_accum} "
+                f"(each of the {grad_accum} microbatches must still split "
+                f"over the {dp}-way batch sharding); pick a grad_accum "
+                f"dividing {global_batch_size // dp}"
+            )
+    accum_jdt = _ACCUM_DTYPES[accum_dtype]
+    micro_sharding = NamedSharding(
+        mesh, PartitionSpec(None, *token_sharding.spec)
+    )
+
+    def _forward_sums(params, apply_fn, inputs, targets, weights):
+        """One forward pass -> (weighted CE sum, token count, aux loss)."""
+        if ce_chunks:
+            hidden, aux = apply_fn(
+                {"params": params}, inputs, return_hidden=True
+            )
+            ce, total_weight = chunked_cross_entropy_loss(
+                hidden, output_head(params), targets, weights,
+                num_chunks=ce_chunks,
+            )
+        else:
+            logits, aux = apply_fn({"params": params}, inputs)
+            ce, total_weight = cross_entropy_loss(logits, targets, weights)
+        return ce * total_weight, total_weight, aux
 
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):
         TRACE_COUNTS["train_step"] += 1
 
         def loss_fn(params):
-            if ce_chunks:
-                hidden, aux = state.apply_fn(
-                    {"params": params}, batch["inputs"], return_hidden=True
-                )
-                ce, total_weight = chunked_cross_entropy_loss(
-                    hidden, output_head(params), batch["targets"],
-                    batch["weights"], num_chunks=ce_chunks,
-                )
-            else:
-                logits, aux = state.apply_fn(
-                    {"params": params}, batch["inputs"]
-                )
-                ce, total_weight = cross_entropy_loss(
-                    logits, batch["targets"], batch["weights"]
-                )
+            ce_sum, total_weight, aux = _forward_sums(
+                params, state.apply_fn, batch["inputs"], batch["targets"],
+                batch["weights"],
+            )
+            ce = ce_sum / total_weight
             return ce + aux, (ce, aux, total_weight)
 
         grads, (ce, aux, total_weight) = jax.grad(loss_fn, has_aux=True)(
@@ -435,6 +518,105 @@ def build_sharded_train(
             "step": new_state.step,
         }
         return new_state, metrics
+
+    def _accum_train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        """grad_accum > 1: scan the forward+backward over microbatches.
+
+        The scan carry (the accum_dtype gradient accumulator + scalar loss
+        sums) is donated between iterations by XLA's scan lowering, so the
+        accumulator costs ONE params-sized buffer regardless of N; the
+        sharding constraint pins each accumulator leaf to its param's
+        layout so no iteration gathers it.
+        """
+        TRACE_COUNTS["train_step"] += 1
+        micro = global_batch_size // grad_accum
+
+        def to_micro(name):
+            arr = batch[name]
+            arr = arr.reshape(grad_accum, micro, *arr.shape[1:])
+            return jax.lax.with_sharding_constraint(arr, micro_sharding)
+
+        xs = {k: to_micro(k) for k in ("inputs", "targets", "weights")}
+        # The GLOBAL token count: known before the scan (weights are an
+        # input), it normalizes every microbatch's CE-sum gradient so the
+        # accumulated total equals the full-batch mean-CE gradient exactly
+        # — not a mean-of-means, which would drift whenever microbatches
+        # carry unequal token counts.
+        w_total = jnp.maximum(
+            batch["weights"].astype(jnp.float32).sum(), 1.0
+        )
+
+        def micro_loss(params, mb):
+            ce_sum, _w, aux = _forward_sums(
+                params, state.apply_fn, mb["inputs"], mb["targets"],
+                mb["weights"],
+            )
+            # aux (model-internal regularizers) is a per-microbatch mean:
+            # average it over N so its gradient scale matches full-batch.
+            return ce_sum / w_total + aux / grad_accum, (ce_sum, aux)
+
+        params_shardings = state_shardings.params
+
+        def pin(tree):
+            return jax.tree.map(
+                jax.lax.with_sharding_constraint, tree, params_shardings
+            )
+
+        grads0 = pin(jax.tree.map(
+            lambda p: jnp.zeros(p.shape, accum_jdt), state.params
+        ))
+
+        def accum(carry, mb):
+            gacc, ce_acc, aux_acc = carry
+            g, (ce_sum, aux) = jax.grad(micro_loss, has_aux=True)(
+                state.params, mb
+            )
+            gacc = pin(jax.tree.map(
+                lambda a, gi: a + gi.astype(a.dtype), gacc, g
+            ))
+            return (gacc, ce_acc + ce_sum, aux_acc + aux), None
+
+        (grads, ce_sum, aux_sum), _ = jax.lax.scan(
+            accum, (grads0, jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32)), xs
+        )
+        if reduce_quant == "int8" and "data" in mesh.axis_names:
+            # Deferred once-per-step reduce on the int8 wire format.  Under
+            # GSPMD the scanned grads are already globally summed, so over
+            # the data axis this all-reduces data-replicated values: the
+            # real quantized collective (and its rounding) runs in-program;
+            # exact identity when data=1.
+            from dlrover_tpu.parallel.quantized_collectives import (
+                quantized_all_reduce,
+            )
+            from dlrover_tpu.runtime.mesh import shard_map_compat
+
+            def q_reduce(leaf, sharding):
+                fn = shard_map_compat(
+                    lambda v: quantized_all_reduce(v, "data", mean=True),
+                    mesh=mesh, in_specs=sharding.spec,
+                    out_specs=sharding.spec,
+                )
+                return fn(leaf)
+
+            grads = jax.tree.map(q_reduce, grads, params_shardings)
+        # Hand the optimizer grads in the params' dtype (bf16 accumulation
+        # is a wire/HBM format, not an update format).
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype), grads, state.params
+        )
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            "loss": ce_sum / w_total,
+            "aux_loss": aux_sum / grad_accum,
+            "tokens": w_total,
+            "grad_norm": optax.global_norm(grads),
+            "step": new_state.step,
+        }
+        return new_state, metrics
+
+    if grad_accum > 1:
+        _train_step = _accum_train_step  # noqa: F811 - explicit dispatch
 
     def _wrap_with_rules(fn):
         @functools.wraps(fn)
@@ -488,6 +670,9 @@ def build_sharded_train(
         init_fn=init_jit,
         step_fn=step_jit,
         eval_fn=eval_jit,
+        grad_accum=grad_accum,
+        accum_dtype=accum_dtype,
+        reduce_quant=reduce_quant,
         batch_avals={
             "inputs": token_aval,
             "targets": token_aval,
@@ -499,6 +684,78 @@ def build_sharded_train(
     if cache_key is not None:
         _BUILD_CACHE[cache_key] = train
     return train
+
+
+def elastic_grad_accum(
+    ref_accum: int,
+    ref_world: int,
+    world: int,
+    global_batch_size: int,
+    dp: int,
+) -> int:
+    """Rescale grad_accum for a resized world, tokens/step invariant.
+
+    The global batch (hence tokens/step and the optimizer trajectory) is a
+    property of the compiled program, not the world — what a resize DOES
+    change is the per-device working set.  Scaling N by ``ref_world /
+    world`` keeps each microbatch's per-device rows (so activation HBM)
+    ~constant: half the chips, twice the microbatches, same step
+    semantics.  The target is snapped to the nearest feasible N (one that
+    keeps every microbatch divisible over the ``dp``-way batch sharding),
+    preferring the next LARGER feasible N so the reference per-microbatch
+    HBM budget is never exceeded.
+    """
+    world = max(1, world)
+    ref_world = max(1, ref_world) or world
+    target = max(1, int(round(ref_accum * ref_world / world)))
+    per_shard = max(1, global_batch_size // max(1, dp))
+    feasible = [
+        n for n in range(1, per_shard + 1)
+        if global_batch_size % (max(1, dp) * n) == 0
+    ]
+    if not feasible:
+        return 1
+    larger = [n for n in feasible if n >= target]
+    return min(larger) if larger else max(feasible)
+
+
+def microbatch_phase_plan(
+    grad_accum: int,
+    reduce_quant: str,
+    step_seconds: float,
+) -> list:
+    """Modeled accumulate/reduce/update breakdown of one microbatched step.
+
+    The phases live inside ONE compiled XLA program, so the host cannot
+    time them individually; this apportions the measured step wall time by
+    the same cost model ``auto/tune.py`` prices the knobs with (reduce ~8%
+    of the step on the fp32 wire, ~3% on int8 — the EQuARX ~2.6x byte
+    ratio; update ~4%; the rest accumulates, split evenly over the N
+    microbatches).  Rows are dicts ``{"phase", "micro", "t0", "dur"}``
+    with times relative to step start — consumed by the trainer's
+    telemetry emission (attr ``source="modeled"``) and by
+    ``tools/trace_steps.py``'s per-microbatch table.
+    """
+    reduce_frac = 0.03 if reduce_quant == "int8" else 0.08
+    update_frac = 0.04
+    accum_total = step_seconds * (1.0 - reduce_frac - update_frac)
+    per_micro = accum_total / max(1, grad_accum)
+    rows = []
+    for i in range(grad_accum):
+        rows.append({
+            "phase": "accumulate", "micro": i,
+            "t0": i * per_micro, "dur": per_micro,
+        })
+    rows.append({
+        "phase": "reduce", "micro": -1,
+        "t0": accum_total, "dur": step_seconds * reduce_frac,
+    })
+    rows.append({
+        "phase": "update", "micro": -1,
+        "t0": step_seconds * (1.0 - update_frac),
+        "dur": step_seconds * update_frac,
+    })
+    return rows
 
 
 def shard_batch(

@@ -61,6 +61,20 @@ def parse_args():
                    help="persistent XLA compilation cache dir (default: "
                         "$DLROVER_TPU_COMPILE_CACHE, else derived from "
                         "--checkpoint-dir; restarts skip recompiling)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per step: split the global batch "
+                        "into N sequential microbatches and accumulate "
+                        "gradients (same tokens/step, 1/N the activation "
+                        "HBM; rescaled automatically on elastic resizes "
+                        "so the optimizer trajectory is preserved)")
+    p.add_argument("--accum-dtype", default="float32",
+                   help="gradient accumulator dtype: float32 (default) | "
+                        "bfloat16 (halves accumulator HBM, adds rounding "
+                        "noise across microbatches)")
+    p.add_argument("--reduce-quant", default="none",
+                   help="wire format of the once-per-step deferred DP "
+                        "gradient reduce: none (full precision) | int8 "
+                        "(block-quantized EQuARX-style all-reduce)")
     p.add_argument("--timeline", default="",
                    help="write this process's telemetry (step/compile/"
                         "checkpoint spans) as a Chrome-trace JSON at exit "
@@ -111,6 +125,9 @@ def main():
             prefetch_to_device=args.prefetch,
             warmup_compile=args.warmup_compile,
             compile_cache_dir=args.compile_cache_dir,
+            grad_accum=args.grad_accum,
+            accum_dtype=args.accum_dtype,
+            reduce_quant=args.reduce_quant,
         ),
         client=client,
     )

@@ -293,3 +293,42 @@ def test_remat_broadcast_codes_roundtrip():
         tune._encode_remat("offload:attn_out,mlp_wo")
     with pytest.raises(ValueError):
         tune._encode_remat("no_such_policy")
+
+
+def test_pick_grad_accum_prefers_smallest_fitting():
+    """The tuner picks the smallest feasible N that fits HBM: plentiful
+    memory -> N=1; shrinking budgets force more microbatches; a bf16
+    accumulator never needs MORE microbatches than fp32 at equal HBM."""
+    from dlrover_tpu.auto import pick_grad_accum
+    from dlrover_tpu.runtime.mesh import ParallelConfig
+
+    cfg = gpt2_config("1.5b", max_seq_len=2048)
+    par = ParallelConfig(data=8)
+    roomy = pick_grad_accum(
+        cfg, par, 64, 2048, remat="full", hbm_bytes=10_000e9
+    )
+    assert roomy == 1
+    tight = pick_grad_accum(
+        cfg, par, 64, 2048, remat="full", hbm_bytes=16e9
+    )
+    assert tight > 1
+    assert 64 % (8 * tight) == 0  # feasible: microbatch divides dp
+    bf16 = pick_grad_accum(
+        cfg, par, 64, 2048, remat="full", hbm_bytes=16e9,
+        accum_dtype="bf16",
+    )
+    assert bf16 <= tight
+
+
+def test_est_comm_time_prices_int8_cheaper():
+    """est_comm_time: zero without a data axis; int8 beats fp32 on the
+    wire for a wire-bound reduce."""
+    from dlrover_tpu.auto import est_comm_time
+    from dlrover_tpu.runtime.mesh import ParallelConfig
+
+    cfg = gpt2_config("1.5b", max_seq_len=2048)
+    assert est_comm_time(cfg, ParallelConfig(data=1, fsdp=8)) == 0.0
+    full = est_comm_time(cfg, ParallelConfig(data=8), "none")
+    int8 = est_comm_time(cfg, ParallelConfig(data=8), "int8")
+    assert full > 0 and int8 > 0
+    assert int8 < full

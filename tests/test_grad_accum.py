@@ -1,0 +1,265 @@
+"""Microbatch engine: accumulation parity, retrace accounting, elastic
+effective-batch invariance, and the quantized deferred reduce.
+
+Parity tests use SGD: it is linear in the gradient, so the only difference
+between grad_accum=N and the full-batch step is fp32 summation order.
+AdamW's ``m / sqrt(v)`` normalization amplifies that reassociation noise
+to ~2x the learning rate at step 1, which would force a tolerance loose
+enough to be meaningless.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.transformer import TransformerLM
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.trainer import train_lib
+
+TINY = gpt2_config(
+    "124m", num_layers=2, d_model=64, num_heads=4,
+    vocab_size=256, max_seq_len=64,
+)
+
+
+def _make_batch(batch=32, seq=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, vocab, size=(batch, seq + 1), dtype=np.int32)
+    return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+def _build(grad_accum=1, accum_dtype="float32", reduce_quant="none",
+           optimizer="sgd", batch=32, seq=16,
+           parallel=ParallelConfig(data=4, fsdp=2)):
+    mesh = build_mesh(parallel)
+    model = TransformerLM(TINY)
+    opt = train_lib.make_optimizer(optimizer, learning_rate=1e-2)
+    return train_lib.build_sharded_train(
+        model, opt, mesh, lr.DEFAULT_RULES,
+        global_batch_size=batch, seq_len=seq,
+        grad_accum=grad_accum, accum_dtype=accum_dtype,
+        reduce_quant=reduce_quant,
+    )
+
+
+def _one_step(train, batch=32, seq=16, seed=0):
+    state = train.init(jax.random.PRNGKey(0))
+    b = train_lib.shard_batch(
+        _make_batch(batch, seq, TINY.vocab_size, seed), train
+    )
+    state, metrics = train.step(state, b)
+    return state, {k: float(v) for k, v in metrics.items()}
+
+
+def _flat_params(state):
+    leaves = jax.tree.leaves(state.params)
+    return np.concatenate([np.asarray(l, np.float64).ravel() for l in leaves])
+
+
+def test_grad_accum_parity_fp32():
+    """grad_accum=4 with an fp32 accumulator matches the full-batch step:
+    loss exactly-ish (same math, different reduction order) and the SGD
+    parameter update within fp32 reassociation tolerance."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    full_state, full_m = _one_step(_build(grad_accum=1))
+    acc_state, acc_m = _one_step(_build(grad_accum=4))
+    np.testing.assert_allclose(acc_m["loss"], full_m["loss"], rtol=1e-5)
+    np.testing.assert_allclose(acc_m["tokens"], full_m["tokens"])
+    np.testing.assert_allclose(
+        _flat_params(acc_state), _flat_params(full_state),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_grad_accum_bf16_accumulator_tolerance():
+    """bf16 accumulation halves accumulator HBM at the price of ~8 bits of
+    mantissa per add: loss is microbatch-exact (computed in fp32 before
+    the cast) but the summed gradient — hence the SGD update — only
+    tracks the fp32 path to bf16 resolution (~1e-2 relative)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    full_state, full_m = _one_step(_build(grad_accum=1))
+    acc_state, acc_m = _one_step(_build(grad_accum=4, accum_dtype="bf16"))
+    np.testing.assert_allclose(acc_m["loss"], full_m["loss"], rtol=1e-5)
+    np.testing.assert_allclose(
+        _flat_params(acc_state), _flat_params(full_state),
+        rtol=2e-2, atol=2e-4,
+    )
+
+
+def test_grad_accum_int8_reduce_path():
+    """reduce_quant="int8" routes the deferred DP reduce through the
+    block-quantized all-reduce; on data-replicated gradients the reduce is
+    a quantization roundtrip, so the update stays within the int8 block
+    error bound of the fp32 path."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    full_state, full_m = _one_step(_build(grad_accum=1))
+    q_state, q_m = _one_step(_build(grad_accum=4, reduce_quant="int8"))
+    np.testing.assert_allclose(q_m["loss"], full_m["loss"], rtol=1e-5)
+    np.testing.assert_allclose(
+        _flat_params(q_state), _flat_params(full_state),
+        rtol=0.05, atol=1e-3,
+    )
+
+
+def test_grad_accum_one_retrace():
+    """The scan engine compiles ONCE: repeated steps on fresh batches must
+    not retrace (TRACE_COUNTS unchanged after the first step)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    train = _build(grad_accum=4)
+    state = train.init(jax.random.PRNGKey(0))
+    traces = []
+    for seed in range(3):
+        b = train_lib.shard_batch(
+            _make_batch(32, 16, TINY.vocab_size, seed), train
+        )
+        state, _ = train.step(state, b)
+        traces.append(train_lib.TRACE_COUNTS["train_step"])
+    assert traces[0] == traces[1] == traces[2], (
+        f"microbatched step retraced: {traces}"
+    )
+
+
+def test_grad_accum_non_divisible_raises():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    with pytest.raises(ValueError, match="divisible by dp\\*grad_accum"):
+        _build(grad_accum=3, batch=32)  # dp=8 -> 32 % 24 != 0
+
+
+def test_grad_accum_validation():
+    mesh = build_mesh(ParallelConfig())
+    model = TransformerLM(TINY)
+    opt = train_lib.make_optimizer("sgd", learning_rate=1e-2)
+    with pytest.raises(ValueError, match="grad_accum"):
+        train_lib.build_sharded_train(
+            model, opt, mesh, lr.DEFAULT_RULES,
+            global_batch_size=16, seq_len=16, grad_accum=0,
+        )
+    with pytest.raises(ValueError, match="accum_dtype"):
+        train_lib.build_sharded_train(
+            model, opt, mesh, lr.DEFAULT_RULES,
+            global_batch_size=16, seq_len=16, accum_dtype="fp8",
+        )
+    with pytest.raises(ValueError, match="reduce_quant"):
+        train_lib.build_sharded_train(
+            model, opt, mesh, lr.DEFAULT_RULES,
+            global_batch_size=16, seq_len=16, reduce_quant="int4",
+        )
+
+
+def test_elastic_grad_accum_resolver():
+    """Half the world -> double the microbatches; snapping prefers the
+    next larger feasible N so per-microbatch HBM never exceeds the
+    reference budget."""
+    f = train_lib.elastic_grad_accum
+    # Same world: unchanged.
+    assert f(4, 16, 16, 256, dp=8) == 4
+    # Half the chips: N doubles (tokens/step constant by construction).
+    assert f(4, 16, 8, 256, dp=4) == 8
+    # Double the chips: N halves.
+    assert f(4, 8, 16, 256, dp=16) == 2
+    # Infeasible exact target snaps UP to the next divisor.
+    assert f(3, 8, 4, 16, dp=2) == 8  # target 6; divisors of 8: snap to 8
+    # Target beyond every feasible N clamps to the largest.
+    assert f(8, 64, 1, 16, dp=8) == 2
+    # Degenerate: nothing feasible beyond N=1.
+    assert f(4, 8, 4, 8, dp=8) == 1
+
+
+def test_microbatch_phase_plan_covers_step():
+    rows = train_lib.microbatch_phase_plan(4, "int8", 1.0)
+    accum = [r for r in rows if r["phase"] == "accumulate"]
+    assert [r["micro"] for r in accum] == [0, 1, 2, 3]
+    assert {r["phase"] for r in rows} == {"accumulate", "reduce", "update"}
+    total = sum(r["dur"] for r in rows)
+    np.testing.assert_allclose(total, 1.0, rtol=1e-6)
+    # int8 wire prices the reduce cheaper than full precision.
+    full = train_lib.microbatch_phase_plan(4, "none", 1.0)
+    dur = lambda rs: next(r["dur"] for r in rs if r["phase"] == "reduce")
+    assert dur(rows) < dur(full)
+
+
+def test_cache_key_includes_accum_knobs():
+    from dlrover_tpu.runtime.compile_cache import train_cache_key
+
+    base = dict(
+        global_batch_size=16, seq_len=16, optimizer="sgd",
+    )
+    k1 = train_cache_key(TINY, (4, 2), **base)
+    k2 = train_cache_key(TINY, (4, 2), **base, grad_accum=4)
+    k3 = train_cache_key(
+        TINY, (4, 2), **base, grad_accum=4, reduce_quant="int8"
+    )
+    k4 = train_cache_key(
+        TINY, (4, 2), **base, grad_accum=4, accum_dtype="bf16"
+    )
+    assert len({k1, k2, k3, k4}) == 4
+
+
+def test_elastic_trainer_resize_invariance(tmp_path, monkeypatch):
+    """A 'resize' (reference world 16 -> actual world 8) rescales
+    grad_accum so tokens/step is invariant, and the booked reference in
+    the checkpoint extra survives a restore into a fresh trainer."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    monkeypatch.setenv(
+        "DLROVER_TPU_JOB", f"ga{os.getpid()}_{tmp_path.name}"
+    )
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+
+    def loader(n, batch=32, seq=32, seed=0):
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            t = rng.integers(0, 256, size=(batch, seq + 1), dtype=np.int32)
+            yield {"inputs": t[:, :-1], "targets": t[:, 1:]}
+
+    cfg = gpt2_config(
+        "124m", num_layers=1, d_model=64, num_heads=2,
+        vocab_size=256, max_seq_len=32,
+    )
+    common = dict(
+        global_batch_size=32, seq_len=32, optimizer="sgd",
+        learning_rate=1e-2, checkpoint_dir=str(tmp_path / "ckpt"),
+        ckpt_every=2,
+    )
+    # "Before the resize": grad_accum=2 booked at a 16-chip world.
+    first = ElasticTrainer(
+        cfg,
+        TrainerConfig(**common, grad_accum=2, grad_accum_ref_world=16),
+        client=None,
+    )
+    # The 8-device world is half the reference: N doubles, tokens/step
+    # (= global_batch x seq) is unchanged by construction.
+    assert first.train.grad_accum == 4
+    tokens_before = first.config.global_batch_size * first.config.seq_len
+    first.fit(loader(4), max_steps=2)
+    extra = first._accum_extra()
+    first.close()
+    assert extra["grad_accum_ref"] == {"accum": 2, "world": 16}
+
+    # "After the restart": a fresh trainer with NO accum config adopts the
+    # booked reference from the checkpoint and resolves the same N.
+    second = ElasticTrainer(cfg, TrainerConfig(**common), client=None)
+    try:
+        assert second.step == 2
+        assert second.train.grad_accum == 4
+        tokens_after = (
+            second.config.global_batch_size * second.config.seq_len
+        )
+        assert tokens_after == tokens_before
+    finally:
+        second.close()

@@ -74,6 +74,55 @@ def test_quantized_all_reduce_single_member_is_identity():
     np.testing.assert_array_equal(np.asarray(reduce(x)), np.asarray(x))
 
 
+@pytest.mark.parametrize("size", [700, 513, 256, 3, 1])
+def test_quantized_all_reduce_partial_blocks(size):
+    """Leaves whose flat size is not a multiple of the quant block (or of
+    the member count) pad-and-mask instead of erroring — gradient pytrees
+    hand this path biases (tiny), norms (odd), and full matrices alike."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = build_mesh(ParallelConfig(data=4, fsdp=2))
+    rng = np.random.default_rng(size)
+    x = jnp.asarray(rng.normal(size=(4, size)), jnp.float32)
+
+    @functools.partial(
+        shard_map_compat, mesh=mesh,
+        in_specs=P("data", None), out_specs=P("data", None),
+    )
+    def reduce(block):
+        return quantized_all_reduce(block[0], "data", block=256)[None]
+
+    got = np.asarray(reduce(x))
+    want = np.asarray(jnp.mean(x, axis=0))
+    assert got.shape == x.shape
+    np.testing.assert_allclose(got[0], want, atol=0.06, rtol=0.06)
+
+
+def test_quantized_all_reduce_preserves_dtype():
+    """bf16 gradient leaves come back bf16 (and the original shape): the
+    deferred-reduce caller feeds whatever dtype the accumulator used."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = build_mesh(ParallelConfig(data=4, fsdp=2))
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(4, 5, 70)), jnp.bfloat16)
+
+    @functools.partial(
+        shard_map_compat, mesh=mesh,
+        in_specs=P("data", None, None), out_specs=P("data", None, None),
+    )
+    def reduce(block):
+        return quantized_all_reduce(block[0], "data", block=256)[None]
+
+    got = reduce(x)
+    assert got.dtype == jnp.bfloat16
+    assert got.shape == x.shape
+    want = np.asarray(jnp.mean(x.astype(jnp.float32), axis=0))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32)[0], want, atol=0.08, rtol=0.08,
+    )
+
+
 def test_local_sgd_quantized_transport_single_host():
     """In a one-process world the transport takes the exact early exit
     (nothing to compress); the quantized-comm outer loop stays exact."""

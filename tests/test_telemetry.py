@@ -76,6 +76,20 @@ def test_event_duration_selects_kind():
     assert timed[1] == "span" and timed[3] == 1.5
 
 
+def test_event_t_mono_backdates():
+    """Modeled sub-phases (microbatch accumulate/reduce/update) are
+    recorded after their enclosing step span closes but placed at
+    caller-captured times inside it."""
+    r = _recorder()
+    t0 = time.monotonic() - 2.5
+    r.event("accumulate", duration_s=1.0, t_mono=t0, micro=0)
+    r.event("accumulate", duration_s=1.0, t_mono=t0 + 1.0, micro=1)
+    first, second = r.drain()
+    assert first[1] == "span" and second[1] == "span"
+    assert abs(second[2] - first[2] - 1.0) < 0.01
+    assert first[2] < time.time() - 2.0  # backdated, not "now"
+
+
 def test_wall_clock_anchor():
     r = _recorder()
     r.event("tick")

@@ -67,6 +67,25 @@ def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):
     assert any(e["ph"] == "i" for e in trace["traceEvents"])
 
 
+def test_trace_steps_microbatch_phases():
+    """With the microbatch engine on, trace_steps attaches per-microbatch
+    accumulate/reduce/update phase rows that tile the measured step."""
+    tool = _load_module(
+        os.path.join(REPO, "tools", "trace_steps.py"), "_trace_steps"
+    )
+    out = tool.run_trace(
+        steps=2, metrics_lag=0, prefetch=0, batch=16,
+        grad_accum=2, reduce_quant="int8",
+    )
+    assert out["grad_accum"] == 2
+    rows = out["microbatch_phases"]
+    assert [r["phase"] for r in rows] == [
+        "accumulate", "accumulate", "reduce", "update",
+    ]
+    assert [r["micro"] for r in rows] == [0, 1, -1, -1]
+    assert all(r["dur_s"] > 0 for r in rows)
+
+
 def test_train_lm_timeline_flag(tmp_path, monkeypatch):
     """The example's ``--timeline`` writes a Chrome trace holding the run's
     step spans (standalone mode: the local ring is the source)."""

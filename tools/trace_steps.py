@@ -21,6 +21,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -57,6 +58,9 @@ def run_trace(
     layers: int = 2,
     d_model: int = 64,
     heads: int = 2,
+    grad_accum: int = 1,
+    accum_dtype: str = "float32",
+    reduce_quant: str = "none",
 ) -> dict:
     """Train ``steps`` tiny steps and return the pipeline timeline.
 
@@ -89,17 +93,23 @@ def run_trace(
             report_every=report_every,
             metrics_lag=metrics_lag,
             prefetch_to_device=prefetch,
+            grad_accum=grad_accum,
+            accum_dtype=accum_dtype,
+            reduce_quant=reduce_quant,
         ),
         client=None,
     )
     batches = make_batches(steps, vocab, seq_len, batch)
     counters = pipeline_counters()
     counters.reset()
+    t0 = time.perf_counter()
     trainer.fit(batches, max_steps=steps)
+    step_s = (time.perf_counter() - t0) / max(1, steps)
+    resolved_accum = trainer.train.grad_accum
     trainer.close()
     table = counters.per_step_table()
     summary = counters.summary()
-    return {
+    out = {
         "mode": "pipelined" if metrics_lag > 0 else "sync",
         "steps": steps,
         "metrics_lag": metrics_lag,
@@ -107,6 +117,27 @@ def run_trace(
         "per_step": table,
         "summary": summary,
     }
+    if resolved_accum > 1:
+        # Microbatch engine active: attach the per-step phase breakdown
+        # (N accumulate rows + one deferred reduce + one update) the
+        # telemetry plane books under the step span — same model as
+        # train_lib.microbatch_phase_plan, scaled to the measured step.
+        from dlrover_tpu.trainer import train_lib
+
+        out["grad_accum"] = resolved_accum
+        out["reduce_quant"] = reduce_quant
+        out["microbatch_phases"] = [
+            {
+                "phase": row["phase"],
+                "micro": row["micro"],
+                "t0_s": round(row["t0"], 6),
+                "dur_s": round(row["dur"], 6),
+            }
+            for row in train_lib.microbatch_phase_plan(
+                resolved_accum, reduce_quant, step_s
+            )
+        ]
+    return out
 
 
 def main() -> int:
@@ -121,6 +152,12 @@ def main() -> int:
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--heads", type=int, default=2)
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per step; > 1 adds per-microbatch "
+                        "accumulate/reduce/update phase rows to the output")
+    p.add_argument("--accum-dtype", default="float32")
+    p.add_argument("--reduce-quant", default="none",
+                   help="none | int8 (deferred DP reduce wire format)")
     args = p.parse_args()
     out = run_trace(
         steps=args.steps,
@@ -133,8 +170,26 @@ def main() -> int:
         layers=args.layers,
         d_model=args.d_model,
         heads=args.heads,
+        grad_accum=args.grad_accum,
+        accum_dtype=args.accum_dtype,
+        reduce_quant=args.reduce_quant,
     )
     print(json.dumps(out, indent=2))
+    if out.get("microbatch_phases"):
+        print(
+            f"\nmicrobatch phases (grad_accum={out['grad_accum']}, "
+            f"reduce_quant={out['reduce_quant']}, modeled within the "
+            f"measured step):",
+            file=sys.stderr,
+        )
+        for row in out["microbatch_phases"]:
+            micro = row["micro"] if row["micro"] >= 0 else "-"
+            print(
+                f"  {row['phase']:<10} micro={micro:<3} "
+                f"t0={row['t0_s'] * 1e3:8.2f}ms "
+                f"dur={row['dur_s'] * 1e3:8.2f}ms",
+                file=sys.stderr,
+            )
     return 0
 
 

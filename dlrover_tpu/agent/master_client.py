@@ -13,7 +13,6 @@ from typing import Dict, Optional
 
 import grpc
 
-from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master import messages as msg
 from dlrover_tpu.master.servicer import GET, REPORT
 

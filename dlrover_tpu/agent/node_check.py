@@ -15,7 +15,7 @@ pairwise bisection (SURVEY.md §3.5).
 from __future__ import annotations
 
 import time
-from typing import Optional, Tuple
+from typing import Tuple
 
 from dlrover_tpu.common.log import default_logger as logger
 

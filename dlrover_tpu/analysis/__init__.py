@@ -1,0 +1,35 @@
+"""tracelint: JAX-aware static analysis for this repo's own failure modes.
+
+Every rule in :mod:`dlrover_tpu.analysis.rules` is grounded in an incident
+this codebase actually hit (see PROFILE.md "Static analysis" for the rule
+-> incident map).  The engine is deliberately small: pure-stdlib ``ast``
+walking, a pluggable rule registry, inline suppressions
+(``# tracelint: disable=TRC002``), and a checked-in JSON baseline for
+grandfathered findings — so the tier-1 gate can run it over the whole
+package on every test run (``tests/test_lint_gate.py``) without any
+third-party linter installed.
+
+Entry points:
+
+* ``tools/tracelint.py`` — the CLI (text/JSON output, stable exit codes).
+* :func:`dlrover_tpu.analysis.engine.run_paths` — the in-process API the
+  tests drive.
+"""
+
+from dlrover_tpu.analysis.core import (  # noqa: F401  (public API re-export)
+    Finding,
+    FileContext,
+    Rule,
+    all_rules,
+    get_rule,
+    register,
+)
+from dlrover_tpu.analysis.engine import (  # noqa: F401
+    Report,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+
+# Importing the rules package registers every built-in rule.
+from dlrover_tpu.analysis import rules as _rules  # noqa: F401

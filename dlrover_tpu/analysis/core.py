@@ -1,0 +1,175 @@
+"""tracelint core: findings, the rule registry, and per-file context.
+
+A rule is a class with a stable ``id`` (``TRC001``-style), registered via
+the :func:`register` decorator; ``check(ctx)`` yields :class:`Finding`
+objects for one parsed file.  The engine (``analysis/engine.py``) owns
+file walking, suppression filtering, baselines and rendering — rules only
+look at one :class:`FileContext` at a time, which keeps them unit-testable
+against fixture snippets (``tests/test_tracelint.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Type
+
+#: Inline suppression syntax, anywhere in a line's trailing comment:
+#:   x = device_get(y)  # tracelint: disable=TRC002
+#:   y = bad()          # tracelint: disable=TRC002,THR001
+#:   z = worse()        # tracelint: disable=all
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracelint:\s*disable=([A-Za-z0-9_,\s]+|all)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location.
+
+    ``symbol`` anchors the finding for baseline matching: a function,
+    class or attribute name that survives unrelated edits, so baselined
+    findings don't churn on line-number drift.
+    """
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    symbol: str = ""
+
+    @property
+    def baseline_key(self) -> str:
+        anchor = self.symbol or self.message
+        return f"{self.rule}::{self.path}::{anchor}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} {self.message}"
+        )
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "symbol": self.symbol,
+        }
+
+
+class FileContext:
+    """One parsed source file handed to every rule."""
+
+    def __init__(self, rel_path: str, source: str, tree: ast.Module):
+        self.rel_path = rel_path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        """line number -> rule ids disabled on that line ({"all"} wildcards)."""
+        if self._suppressions is None:
+            table: Dict[int, Set[str]] = {}
+            for lineno, line in enumerate(self.lines, start=1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                spec = m.group(1).strip()
+                if spec == "all":
+                    table[lineno] = {"all"}
+                else:
+                    table[lineno] = {
+                        part.strip().upper()
+                        for part in spec.split(",")
+                        if part.strip()
+                    }
+            self._suppressions = table
+        return self._suppressions
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressions.get(finding.line)
+        return bool(ids) and ("all" in ids or finding.rule in ids)
+
+    def finding(
+        self,
+        rule: str,
+        node: ast.AST,
+        message: str,
+        symbol: str = "",
+    ) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.rel_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            symbol=symbol,
+        )
+
+
+class Rule:
+    """Base class: subclass, set the class attrs, implement ``check``."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        """``check`` with defensive isolation: one rule crashing on an odd
+        construct must not take the whole gate down — it becomes its own
+        finding instead, so the breakage is visible, not silent."""
+        try:
+            yield from self.check(ctx)
+        except Exception as e:  # noqa: BLE001 - isolation boundary
+            yield Finding(
+                rule=self.id,
+                path=ctx.rel_path,
+                line=1,
+                col=1,
+                message=f"rule crashed: {type(e).__name__}: {e}",
+                symbol="__rule_crash__",
+            )
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding one instance of ``rule_cls`` to the registry."""
+    if not rule_cls.id:
+        raise ValueError(f"{rule_cls.__name__} has no rule id")
+    if rule_cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule_cls.id}")
+    _REGISTRY[rule_cls.id] = rule_cls()
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    return [_REGISTRY[rule_id] for rule_id in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _REGISTRY[rule_id.upper()]
+
+
+def select_rules(select: Optional[Iterable[str]] = None) -> List[Rule]:
+    if not select:
+        return all_rules()
+    wanted = {s.strip().upper() for s in select if s.strip()}
+    unknown = wanted - set(_REGISTRY)
+    if unknown:
+        raise KeyError(
+            f"unknown rule id(s) {sorted(unknown)}; "
+            f"known: {sorted(_REGISTRY)}"
+        )
+    return [_REGISTRY[rule_id] for rule_id in sorted(wanted)]

@@ -1,0 +1,191 @@
+"""tracelint engine: file walking, baselines, reports, exit codes.
+
+Exit-code contract (stable; the tier-1 gate and CI scripts key on it):
+
+* ``0`` — no non-baselined, non-suppressed findings.
+* ``1`` — findings present.
+* ``2`` — usage or internal error (unparseable arguments, unknown rule,
+  unreadable baseline).  A syntactically invalid *analyzed* file is a
+  finding (every rule would be blind to it), not an engine error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from dlrover_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    select_rules,
+)
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+#: Default baseline location, repo-relative (next to pyproject.toml).
+DEFAULT_BASELINE = "tracelint_baseline.json"
+
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", ".pytest_cache"}
+
+
+@dataclasses.dataclass
+class Report:
+    """Outcome of one engine run."""
+
+    findings: List[Finding]
+    suppressed: int = 0
+    baselined: int = 0
+    files_checked: int = 0
+    rules_run: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return EXIT_FINDINGS if self.findings else EXIT_CLEAN
+
+    def counts_by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for finding in self.findings:
+            out[finding.rule] = out.get(finding.rule, 0) + 1
+        return out
+
+    def render_text(self) -> str:
+        lines = [finding.render() for finding in self.findings]
+        summary = (
+            f"tracelint: {len(self.findings)} finding(s) in "
+            f"{self.files_checked} file(s) "
+            f"({self.suppressed} suppressed, {self.baselined} baselined)"
+        )
+        if self.findings:
+            by_rule = ", ".join(
+                f"{rule}={n}" for rule, n in sorted(
+                    self.counts_by_rule().items()
+                )
+            )
+            summary += f" [{by_rule}]"
+        lines.append(summary)
+        return "\n".join(lines)
+
+    def render_json(self) -> str:
+        return json.dumps(
+            {
+                "version": 1,
+                "findings": [f.to_json() for f in self.findings],
+                "counts": self.counts_by_rule(),
+                "suppressed": self.suppressed,
+                "baselined": self.baselined,
+                "files_checked": self.files_checked,
+                "rules_run": self.rules_run,
+                "exit_code": self.exit_code,
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """baseline_key -> reason.  Entries are written by ``--write-baseline``
+    and are expected to carry a human ``reason`` explaining why the finding
+    is grandfathered rather than fixed."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[str, str] = {}
+    for entry in data.get("findings", []):
+        key = (
+            f"{entry['rule']}::{entry['path']}::"
+            f"{entry.get('symbol') or entry.get('message', '')}"
+        )
+        out[key] = entry.get("reason", "")
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    data = {
+        "comment": (
+            "tracelint baseline: grandfathered findings.  Each entry "
+            "should carry a 'reason'; prefer fixing over baselining."
+        ),
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "symbol": f.symbol or f.message,
+                "reason": "TODO: justify or fix",
+            }
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def run_paths(
+    paths: Sequence[str],
+    *,
+    select: Optional[Sequence[str]] = None,
+    baseline: Optional[Dict[str, str]] = None,
+    root: Optional[str] = None,
+) -> Report:
+    """Analyze every ``.py`` under ``paths`` with the selected rules.
+
+    ``root`` anchors the repo-relative paths findings (and baselines) use;
+    it defaults to the common parent of ``paths``' absolute forms' CWD —
+    in practice, pass the repo root.
+    """
+    rules: List[Rule] = select_rules(select)
+    baseline = baseline or {}
+    root = os.path.abspath(root or os.getcwd())
+    report = Report(findings=[], rules_run=len(rules))
+    for file_path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(file_path), root)
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(file_path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            report.findings.append(Finding(
+                rule="ENGINE", path=rel, line=1, col=1,
+                message=f"unreadable: {e}", symbol="__unreadable__",
+            ))
+            continue
+        report.files_checked += 1
+        try:
+            tree = ast.parse(source, filename=file_path)
+        except SyntaxError as e:
+            report.findings.append(Finding(
+                rule="ENGINE", path=rel, line=e.lineno or 1, col=1,
+                message=f"syntax error: {e.msg}", symbol="__syntax__",
+            ))
+            continue
+        ctx = FileContext(rel, source, tree)
+        for rule in rules:
+            for finding in rule.run(ctx):
+                if ctx.is_suppressed(finding):
+                    report.suppressed += 1
+                elif finding.baseline_key in baseline:
+                    report.baselined += 1
+                else:
+                    report.findings.append(finding)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return report

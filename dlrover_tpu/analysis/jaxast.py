@@ -1,0 +1,251 @@
+"""Shared AST utilities for the JAX-aware rules.
+
+The heavy lifting every trace rule needs: resolving dotted call names,
+finding which locally-defined functions end up inside a JAX trace
+(arguments to ``jit``/``scan``/``shard_map``/... or decorated with them),
+and a light intra-module call graph so a helper called *from* a traced
+function is treated as traced too.
+
+All of this is deliberately approximate in the direction of a linter:
+name-based, last-definition-wins, no cross-module resolution.  Inline
+suppressions and the baseline exist exactly for the residue.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.lax.scan`` for the callee of ``jax.lax.scan(...)``; "" when the
+    expression is not a plain name/attribute chain (subscripts, calls)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)
+
+
+def name_matches(name: str, patterns: Set[str]) -> bool:
+    """True when ``name`` equals a pattern or ends with a dotted pattern —
+    ``jax.lax.scan`` matches both ``lax.scan`` and ``jax.lax.scan``."""
+    if not name:
+        return False
+    if name in patterns:
+        return True
+    for pattern in patterns:
+        if name.endswith("." + pattern):
+            return True
+    return False
+
+
+# Calls whose function-valued arguments are traced by JAX.  ``nn.scan`` /
+# ``nn.remat`` transform module *classes*, not plain callables — flax owns
+# their module bookkeeping, so they are intentionally absent.
+TRACE_ENTRY_CALLS: Set[str] = {
+    "jax.jit", "jit", "pjit",
+    "jax.pmap", "pmap",
+    "jax.vmap", "vmap",
+    "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.shard_map", "shard_map", "shard_map_compat",
+    "jax.eval_shape",
+}
+
+# The subset whose body flax cannot see: constructing an ``nn.Module``
+# inside one of these is the PR 4 ChunkStack bug (TRC001).  Plain ``jit``
+# is excluded — module construction under jit is the linen idiom
+# (``model.apply`` traces ``__call__``, where submodule construction is
+# managed by flax).
+SCAN_ENTRY_CALLS: Set[str] = {
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.shard_map", "shard_map", "shard_map_compat",
+}
+
+
+def iter_functions(
+    tree: ast.AST,
+) -> Iterator[Tuple[str, FunctionNode]]:
+    """Every (qualname, def) in the module, nested defs included."""
+
+    def walk(node: ast.AST, prefix: str) -> Iterator[Tuple[str, FunctionNode]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNCTION_NODES):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from walk(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def _decorator_is_trace_entry(dec: ast.AST, entries: Set[str]) -> bool:
+    """``@jax.jit``, ``@partial(jax.jit, ...)``, ``@jit`` forms."""
+    if isinstance(dec, ast.Call):
+        if name_matches(dotted_name(dec.func), entries):
+            return True
+        # functools.partial(jax.jit, static_argnums=...)
+        if dotted_name(dec.func) in ("partial", "functools.partial"):
+            for arg in dec.args:
+                if name_matches(dotted_name(arg), entries):
+                    return True
+        return False
+    return name_matches(dotted_name(dec), entries)
+
+
+def traced_function_names(
+    tree: ast.AST, entries: Optional[Set[str]] = None
+) -> Set[str]:
+    """Bare names of locally-defined functions that enter a JAX trace.
+
+    A function is traced when (a) its name appears anywhere inside the
+    argument list of a call to an entry point — including wrapped forms
+    like ``jax.jit(_wrap(fn))`` — or (b) it carries a trace-entry
+    decorator.  The set is then closed over the intra-module call graph:
+    helpers invoked from a traced function run under the same trace.
+    """
+    entries = TRACE_ENTRY_CALLS if entries is None else entries
+    defs: Dict[str, FunctionNode] = {}
+    for qual, node in iter_functions(tree):
+        defs[node.name] = node  # bare-name resolution, last def wins
+
+    traced: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and name_matches(
+            call_name(node), entries
+        ):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for ref in ast.walk(arg):
+                    if isinstance(ref, ast.Name) and ref.id in defs:
+                        traced.add(ref.id)
+        elif isinstance(node, FUNCTION_NODES):
+            if any(
+                _decorator_is_trace_entry(d, entries)
+                for d in node.decorator_list
+            ):
+                traced.add(node.name)
+
+    # Close over local calls: fn traced + fn calls helper -> helper traced.
+    changed = True
+    while changed:
+        changed = False
+        for name in list(traced):
+            fn = defs.get(name)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = call_name(node)
+                    bare = callee.split(".")[-1]
+                    if (
+                        callee in defs
+                        and callee not in traced
+                    ):
+                        traced.add(callee)
+                        changed = True
+                    elif (
+                        callee.startswith("self.")
+                        and bare in defs
+                        and bare not in traced
+                    ):
+                        traced.add(bare)
+                        changed = True
+    return traced
+
+
+def traced_functions(
+    tree: ast.AST, entries: Optional[Set[str]] = None
+) -> Dict[str, FunctionNode]:
+    """name -> def node for every traced function (see above)."""
+    names = traced_function_names(tree, entries)
+    out: Dict[str, FunctionNode] = {}
+    for _qual, node in iter_functions(tree):
+        if node.name in names:
+            out[node.name] = node
+    return out
+
+
+def body_nodes(fn: FunctionNode) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s own body, NOT descending into nested defs — a
+    nested function is its own (possibly traced) scope."""
+
+    def walk(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if not isinstance(child, FUNCTION_NODES + (ast.ClassDef,)):
+                yield from walk(child)
+
+    yield from walk(fn)
+
+
+def flax_module_classes(tree: ast.AST) -> Set[str]:
+    """Names of classes defined in this module that are nn.Module subclasses
+    (direct bases only — the linter approximation)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for base in node.bases:
+                base_name = dotted_name(base)
+                if base_name.endswith("nn.Module") or base_name == "Module":
+                    out.add(node.name)
+    return out
+
+
+def enclosing_with_calls(
+    fn: FunctionNode, target: ast.AST
+) -> List[str]:
+    """Dotted names of context-manager calls whose ``with`` blocks lexically
+    enclose ``target`` inside ``fn`` — how TRC002 recognizes a sanctioned
+    ``with pipeline_counters().host_block(...)`` region."""
+    out: List[str] = []
+
+    def walk(node: ast.AST, stack: List[str]) -> bool:
+        if node is target:
+            out.extend(stack)
+            return True
+        pushed = 0
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    name = call_name(expr)
+                    # pipeline_counters().host_block(...) has a Call at the
+                    # attribute root; dotted_name gives "" — recover the
+                    # final attribute.
+                    if not name and isinstance(expr.func, ast.Attribute):
+                        name = expr.func.attr
+                    stack.append(name)
+                    pushed += 1
+        found = False
+        for child in ast.iter_child_nodes(node):
+            if walk(child, stack):
+                found = True
+                break
+        for _ in range(pushed):
+            if not found:
+                stack.pop()
+        return found
+
+    walk(fn, [])
+    return out

@@ -1,0 +1,9 @@
+"""Built-in tracelint rules.  Importing this package registers them all."""
+
+from dlrover_tpu.analysis.rules import (  # noqa: F401  (registration imports)
+    compat,
+    host_sync,
+    logfmt,
+    threads,
+    trace_purity,
+)

@@ -1,0 +1,158 @@
+"""CMP001: version-gated stdlib/JAX APIs used without the compat shim.
+
+Two incidents behind this rule:
+
+* ``import tomllib`` crashed spec parsing on py3.10 (tomllib is 3.11+);
+  the fix was the try/except fallback chain in ``common/job_spec.py``.
+* The ``jax.set_mesh`` / ``jax.shard_map`` era-names broke the seed tree
+  on jax 0.4.37; ``runtime/mesh.py`` now owns the feature-probed shims
+  (``current_mesh`` / ``activate_mesh`` / ``shard_map_compat``) and every
+  other module must route through them.
+
+Flags:
+
+* ``import tomllib`` (or ``from tomllib import ...``) outside a
+  ``try/except ImportError`` fallback.
+* Direct ``jax.set_mesh`` / ``jax.shard_map`` /
+  ``jax.sharding.get_abstract_mesh`` / ``jax.experimental.shard_map``
+  usage anywhere but the shim module itself (or a ``hasattr`` probe).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: Modules that only exist on some supported interpreter versions.
+VERSIONED_IMPORTS: Set[str] = {"tomllib", "graphlib.TopologicalSorter"}
+
+#: The one module allowed to touch era-gated JAX names directly.
+SHIM_BASENAME = "mesh.py"
+SHIM_PATH_HINT = "runtime/mesh.py"
+
+GATED_JAX_NAMES: Set[str] = {
+    "jax.set_mesh",
+    "jax.shard_map",
+    "jax.sharding.get_abstract_mesh",
+}
+GATED_JAX_SHIMS = {
+    "jax.set_mesh": "runtime.mesh.activate_mesh",
+    "jax.shard_map": "runtime.mesh.shard_map_compat",
+    "jax.sharding.get_abstract_mesh": "runtime.mesh.current_mesh",
+}
+GATED_IMPORT_MODULES: Set[str] = {"jax.experimental.shard_map"}
+
+
+def _in_import_fallback(tree: ast.Module, node: ast.AST) -> bool:
+    """Is ``node`` inside a try whose handlers catch ImportError (or a
+    bare/``Exception`` catch — still a fallback)?"""
+    for candidate in ast.walk(tree):
+        if not isinstance(candidate, ast.Try):
+            continue
+        covered = any(
+            n is node for body_stmt in candidate.body
+            for n in ast.walk(body_stmt)
+        )
+        if not covered:
+            continue
+        for handler in candidate.handlers:
+            names = _handler_names(handler)
+            if not names or names & {
+                "ImportError", "ModuleNotFoundError", "Exception",
+                "BaseException",
+            }:
+                return True
+    return False
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Set[str]:
+    if handler.type is None:
+        return set()
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    return {jaxast.dotted_name(t).rsplit(".", 1)[-1] for t in types}
+
+
+def _in_hasattr_probe(tree: ast.Module, node: ast.AST) -> bool:
+    """``if hasattr(jax, "set_mesh"):``-guarded uses are feature-probed."""
+    for candidate in ast.walk(tree):
+        if not isinstance(candidate, ast.If):
+            continue
+        test_calls = [
+            n for n in ast.walk(candidate.test)
+            if isinstance(n, ast.Call)
+            and jaxast.call_name(n) in ("hasattr", "getattr")
+        ]
+        if not test_calls:
+            continue
+        if any(
+            n is node for stmt in candidate.body for n in ast.walk(stmt)
+        ):
+            return True
+    return False
+
+
+@register
+class VersionGatedApi(Rule):
+    id = "CMP001"
+    name = "version-gated-api"
+    description = (
+        "version-gated stdlib/JAX API used without the compat shim "
+        "(tomllib on py3.10, set_mesh/shard_map era names)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        is_shim = ctx.rel_path.endswith(SHIM_PATH_HINT) or (
+            ctx.rel_path.rsplit("/", 1)[-1] == SHIM_BASENAME
+        )
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                yield from self._check_import(ctx, node, is_shim)
+            elif isinstance(node, ast.Attribute) and not is_shim:
+                # Attribute-only: a call's func chain is itself an
+                # Attribute, so checking Call nodes too would double-fire.
+                name = jaxast.dotted_name(node)
+                if name in GATED_JAX_NAMES:
+                    if _in_hasattr_probe(ctx.tree, node):
+                        continue
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{name} is version-gated; use "
+                        f"dlrover_tpu.{GATED_JAX_SHIMS[name]} instead",
+                        symbol=name,
+                    )
+
+    def _check_import(
+        self, ctx: FileContext, node: ast.AST, is_shim: bool
+    ) -> Iterator[Finding]:
+        modules = []
+        if isinstance(node, ast.Import):
+            modules = [alias.name for alias in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            modules = [node.module]
+        for module in modules:
+            if module in VERSIONED_IMPORTS and not _in_import_fallback(
+                ctx.tree, node
+            ):
+                yield ctx.finding(
+                    self.id, node,
+                    f"import {module} is version-gated (py3.11+); wrap "
+                    "it in a try/except ImportError fallback "
+                    "(see common/job_spec.py)",
+                    symbol=f"import:{module}",
+                )
+            if module in GATED_IMPORT_MODULES and not is_shim:
+                if _in_import_fallback(ctx.tree, node):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"import of era-gated {module}; route through "
+                    "dlrover_tpu.runtime.mesh.shard_map_compat",
+                    symbol=f"import:{module}",
+                )

@@ -1,0 +1,142 @@
+"""TRC002: host-device synchronization on the hot step path.
+
+The async step pipeline (PR 2) only overlaps H2D, compute and metrics
+when nothing on the ``ElasticTrainer.fit`` / ``build_sharded_train`` /
+loader path blocks the dispatch thread: one stray ``float(loss)`` per
+step re-serializes host and device and silently erases the pipeline's
+win.  The sanctioned pattern is the deferred-metrics flush — a *batched*
+``jax.device_get`` wrapped in ``pipeline_counters().host_block(...)`` so
+the block is measured and attributed, not hidden.
+
+Heuristic: inside the hot files (trainer loop, train_lib, data loader),
+flag
+
+* ``jax.device_get`` / ``jax.block_until_ready`` / ``.item()`` /
+  ``.block_until_ready()`` calls, and
+* ``float()`` / ``int()`` / ``np.asarray()`` / ``np.array()`` applied to
+  values produced by a ``.step()`` / ``.eval_step()`` / ``.train_step()``
+  call (device-resident metrics),
+
+unless the call sits lexically inside a ``with ... host_block(...)``
+region.  ``__init__``/``close`` (construction, restore, teardown) are off
+the step path and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: Files making up the hot step path, matched by basename (so fixture
+#: files named the same way exercise the rule in tests).
+HOT_FILE_BASENAMES: Set[str] = {
+    "elastic_trainer.py",
+    "train_lib.py",
+    "loader.py",
+}
+
+#: Functions off the step path: construction/restore/teardown.
+EXEMPT_FUNCTIONS: Set[str] = {"__init__", "close", "__del__", "aot_compile"}
+
+SYNC_CALLS: Set[str] = {"jax.device_get", "jax.block_until_ready"}
+SYNC_METHODS: Set[str] = {"item", "block_until_ready"}
+MATERIALIZERS: Set[str] = {"float", "int", "np.asarray", "np.array"}
+
+#: Call suffixes whose results are device-resident step outputs.
+DEVICE_PRODUCERS = (".step", ".eval_step", ".train_step")
+
+#: Context-manager call names that sanction a measured host block.
+SANCTIONED_CONTEXTS: Set[str] = {"host_block"}
+
+
+def _device_origin_names(fn: jaxast.FunctionNode) -> Set[str]:
+    """Local names bound (possibly via tuple unpack) to the result of a
+    device-producing call, minus names later rebound to a fetched (host)
+    value — a linter-grade, order-insensitive approximation."""
+    device: Set[str] = set()
+    fetched: Set[str] = set()
+    for node in jaxast.body_nodes(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        is_device = isinstance(value, ast.Call) and any(
+            jaxast.call_name(value).endswith(suffix)
+            for suffix in DEVICE_PRODUCERS
+        )
+        is_fetch = isinstance(value, ast.Call) and jaxast.name_matches(
+            jaxast.call_name(value), SYNC_CALLS
+        )
+        if not (is_device or is_fetch):
+            continue
+        for target in node.targets:
+            elements = (
+                target.elts if isinstance(target, ast.Tuple) else [target]
+            )
+            for element in elements:
+                if isinstance(element, ast.Name):
+                    (device if is_device else fetched).add(element.id)
+    return device - fetched
+
+
+@register
+class HostSyncInStepPath(Rule):
+    id = "TRC002"
+    name = "host-sync-in-step-path"
+    description = (
+        "blocking host-device sync on the hot step path outside a "
+        "sanctioned host_block region (serializes the async pipeline)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        basename = ctx.rel_path.rsplit("/", 1)[-1]
+        if basename not in HOT_FILE_BASENAMES:
+            return
+        for fn_name, fn in jaxast.iter_functions(ctx.tree):
+            if fn.name in EXEMPT_FUNCTIONS:
+                continue
+            device_names = _device_origin_names(fn)
+            for node in jaxast.body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = self._sync_reason(node, device_names)
+                if not reason:
+                    continue
+                contexts = jaxast.enclosing_with_calls(fn, node)
+                if any(
+                    c in jaxast.SCAN_ENTRY_CALLS or c in SANCTIONED_CONTEXTS
+                    or c.rsplit(".", 1)[-1] in SANCTIONED_CONTEXTS
+                    for c in contexts
+                ):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"{reason} in {fn_name!r} on the hot step path; "
+                    "defer it into the metrics flush or wrap it in "
+                    "pipeline_counters().host_block(...) so the stall "
+                    "is measured",
+                    symbol=f"{fn_name}:{reason.split(' ')[0]}",
+                )
+
+    def _sync_reason(
+        self, node: ast.Call, device_names: Set[str]
+    ) -> str:
+        callee = jaxast.call_name(node)
+        if jaxast.name_matches(callee, SYNC_CALLS):
+            return f"{callee}() blocking fetch"
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in SYNC_METHODS
+            and not node.args
+        ):
+            return f".{node.func.attr}() blocking sync"
+        if callee in MATERIALIZERS and node.args:
+            for ref in ast.walk(node.args[0]):
+                if isinstance(ref, ast.Name) and ref.id in device_names:
+                    return (
+                        f"{callee}() materializes device metrics "
+                        f"({ref.id!r})"
+                    )
+        return ""

@@ -1,0 +1,101 @@
+"""LOG001: eagerly-formatted logging calls.
+
+``logger.info(f"step {step}")`` formats on every call even when the
+level is filtered out — on the step path that's allocation + formatting
+per step for a message nobody reads, and it breaks message-template
+aggregation in log pipelines.  The house style (everywhere in
+``common/log.py`` consumers) is lazy ``%s`` formatting:
+``logger.info("step %d", step)``.
+
+Flags ``<logger>.debug/info/warning/error/exception/critical`` calls
+whose first argument is an f-string, a ``"..." % x`` expression, or a
+``"...".format(x)`` call.  Receivers are matched by name (``logger``,
+``log``, ``default_logger``, ``self.logger``, ``logging``) so bespoke
+logger attributes still get caught.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+LOG_METHODS: Set[str] = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "fatal", "log",
+}
+
+LOGGER_NAMES: Set[str] = {
+    "logger", "log", "default_logger", "logging", "_logger", "LOG",
+}
+
+
+def _is_logger_receiver(node: ast.AST) -> bool:
+    name = jaxast.dotted_name(node)
+    if not name:
+        return False
+    parts = name.split(".")
+    # logger.info / self.logger.info / cls._logger.info / logging.info
+    return parts[0] in LOGGER_NAMES or (
+        len(parts) >= 2 and parts[-1] in LOGGER_NAMES
+    )
+
+
+def _eager_format_kind(arg: ast.AST) -> str:
+    if isinstance(arg, ast.JoinedStr):
+        return "f-string"
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod):
+        return "%-interpolation"
+    if (
+        isinstance(arg, ast.Call)
+        and isinstance(arg.func, ast.Attribute)
+        and arg.func.attr == "format"
+    ):
+        return ".format() call"
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        # "a" + str(x) concatenation — same eager cost.
+        for part in ast.walk(arg):
+            if isinstance(part, ast.Constant) and isinstance(
+                part.value, str
+            ):
+                return "string concatenation"
+    return ""
+
+
+@register
+class EagerLogFormat(Rule):
+    id = "LOG001"
+    name = "eager-log-format"
+    description = (
+        "f-string/%%-formatted logging call (formats even when the level "
+        "is filtered; use lazy '%s' arguments)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in LOG_METHODS
+                and _is_logger_receiver(func.value)
+            ):
+                continue
+            if not node.args:
+                continue
+            # logger.log(level, msg, ...) carries the template second.
+            arg = node.args[
+                1 if func.attr == "log" and len(node.args) > 1 else 0
+            ]
+            kind = _eager_format_kind(arg)
+            if kind:
+                yield ctx.finding(
+                    self.id, node,
+                    f"{jaxast.dotted_name(func)} called with an eagerly "
+                    f"formatted message ({kind}); pass a '%s' template "
+                    "and arguments instead",
+                    symbol=f"{jaxast.dotted_name(func)}:{node.lineno}",
+                )

@@ -1,0 +1,301 @@
+"""THR001: cross-thread ``self.*`` access without lock discipline.
+
+The PR 2 incident class: a background producer thread (loader prefetch,
+checkpoint saver, telemetry pump) shares instance attributes with the
+methods other threads call (``stop()``, ``set_world()``, a fresh
+``__iter__``), and an unguarded write on either side races the other —
+the stale-producer / torn-world-snapshot bugs that cost real debugging
+time.
+
+Heuristic, per class that starts a ``threading.Thread``:
+
+1. Resolve the thread target (``self.method`` or a local closure) and
+   close it over the intra-class/intra-scope call graph — that's the
+   *thread side*.  Everything else (except ``__init__``, which runs
+   before any thread exists) is the *caller side*.
+2. Collect ``self.attr`` writes and reads per side, noting whether each
+   access sits inside a ``with self.<lock>`` block (an attribute whose
+   name contains "lock"/"mutex" or that the class binds to
+   ``threading.Lock``/``RLock``/``Condition``).
+3. Fire when an attribute is **written without a lock on one side** while
+   the other side accesses it at all.  Attributes holding thread-safe
+   primitives (Event/Lock/Queue/deque, the shm Shared* handles) are
+   exempt — their methods synchronize internally.
+
+Guarding the writes silences the rule; lock-free reads of a
+locked-write attribute are accepted (single-word reads under the GIL).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: Constructor names whose instances synchronize internally.
+THREADSAFE_TYPES: Set[str] = {
+    "threading.Event", "threading.Lock", "threading.RLock",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+    "threading.Thread", "threading.local",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "_queue.Queue",
+    # multiprocessing queues (incl. the ``ctx = mp.get_context(...)``
+    # spelling) synchronize via their own pipe/feeder locks.
+    "multiprocessing.Queue", "mp.Queue", "ctx.Queue",
+    "multiprocessing.JoinableQueue", "mp.JoinableQueue",
+    "ctx.JoinableQueue", "ctx.SimpleQueue",
+    "collections.deque", "deque",
+    "SharedQueue", "SharedLock", "SharedDict", "SharedMemoryHandler",
+    "TelemetryRecorder",
+}
+
+LOCKISH_NAME_PARTS = ("lock", "mutex", "cond")
+
+
+def _is_lockish(attr: str, lock_attrs: Set[str]) -> bool:
+    lowered = attr.lower()
+    return attr in lock_attrs or any(
+        part in lowered for part in LOCKISH_NAME_PARTS
+    )
+
+
+@dataclasses.dataclass
+class _Access:
+    node: ast.AST
+    attr: str
+    is_write: bool
+    locked: bool
+    where: str  # qualified method/closure name
+
+
+class _ClassInfo:
+    """One class's methods, thread targets and self-attribute accesses."""
+
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.methods: Dict[str, jaxast.FunctionNode] = {}
+        # closure name -> (defining method, def node)
+        self.closures: Dict[str, Tuple[str, jaxast.FunctionNode]] = {}
+        for child in node.body:
+            if isinstance(child, jaxast.FUNCTION_NODES):
+                self.methods[child.name] = child
+                for sub in ast.walk(child):
+                    if (
+                        isinstance(sub, jaxast.FUNCTION_NODES)
+                        and sub is not child
+                    ):
+                        self.closures[sub.name] = (child.name, sub)
+
+    # -- thread-side resolution -------------------------------------------
+
+    def thread_targets(self) -> Set[str]:
+        """Function names handed to ``threading.Thread(target=...)``."""
+        targets: Set[str] = set()
+        for node in ast.walk(self.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if jaxast.call_name(node) not in (
+                "threading.Thread", "Thread"
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                name = jaxast.dotted_name(kw.value)
+                if name.startswith("self."):
+                    targets.add(name[len("self."):])
+                elif name:
+                    targets.add(name)
+        return targets
+
+    def thread_side(self) -> Set[str]:
+        """Thread targets closed over ``self.m()`` / local-closure calls."""
+        side = {
+            t for t in self.thread_targets()
+            if t in self.methods or t in self.closures
+        }
+        changed = True
+        while changed:
+            changed = False
+            for name in list(side):
+                fn = self._resolve(name)
+                if fn is None:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = jaxast.call_name(node)
+                    if callee.startswith("self."):
+                        callee = callee[len("self."):]
+                    if (
+                        callee in self.methods or callee in self.closures
+                    ) and callee not in side:
+                        side.add(callee)
+                        changed = True
+        return side
+
+    def _resolve(self, name: str) -> Optional[jaxast.FunctionNode]:
+        if name in self.methods:
+            return self.methods[name]
+        if name in self.closures:
+            return self.closures[name][1]
+        return None
+
+    # -- attribute classification ------------------------------------------
+
+    def threadsafe_attrs(self) -> Tuple[Set[str], Set[str]]:
+        """(attrs bound to thread-safe primitives, attrs that ARE locks)."""
+        safe: Set[str] = set()
+        locks: Set[str] = set()
+        # Scan the whole class, not just ``__init__``: lazily-built queues
+        # (``self._task_queue = ctx.Queue(...)`` inside ``_start``) are just
+        # as thread-safe as eagerly-built ones.
+        for node in ast.walk(self.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            ctor = jaxast.call_name(node.value)
+            if not jaxast.name_matches(ctor, THREADSAFE_TYPES):
+                continue
+            for target in node.targets:
+                name = jaxast.dotted_name(target)
+                if name.startswith("self."):
+                    attr = name[len("self."):]
+                    safe.add(attr)
+                    if ctor.rsplit(".", 1)[-1] in (
+                        "Lock", "RLock", "Condition", "SharedLock",
+                    ):
+                        locks.add(attr)
+        return safe, locks
+
+    def accesses(
+        self, owner: str, fn: jaxast.FunctionNode, lock_attrs: Set[str]
+    ) -> Iterator[_Access]:
+        """Every ``self.attr`` read/write in ``fn``'s own body."""
+        for node in jaxast.body_nodes(fn):
+            attr, is_write = None, False
+            if isinstance(node, ast.Attribute) and jaxast.dotted_name(
+                node
+            ) == f"self.{node.attr}":
+                attr = node.attr
+                is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            if attr is None:
+                continue
+            locked = self._under_lock(fn, node, lock_attrs)
+            yield _Access(node, attr, is_write, locked, owner)
+
+    def _under_lock(
+        self,
+        fn: jaxast.FunctionNode,
+        target: ast.AST,
+        lock_attrs: Set[str],
+    ) -> bool:
+        """Is ``target`` inside ``with self.<lockish>:`` within ``fn``?"""
+
+        def walk(node: ast.AST, held: bool) -> Optional[bool]:
+            if node is target:
+                return held
+            now = held
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    name = jaxast.dotted_name(expr)
+                    if not name and isinstance(expr, ast.Call):
+                        name = jaxast.dotted_name(expr.func)
+                    if name.startswith("self.") and _is_lockish(
+                        name[len("self."):].split(".")[0], lock_attrs
+                    ):
+                        now = True
+            for child in ast.iter_child_nodes(node):
+                found = walk(child, now)
+                if found is not None:
+                    return found
+            return None
+
+        return bool(walk(fn, False))
+
+
+@register
+class CrossThreadAttr(Rule):
+    id = "THR001"
+    name = "cross-thread-attr"
+    description = (
+        "instance attribute written on one thread and accessed from "
+        "another without a held lock"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(
+        self, ctx: FileContext, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        info = _ClassInfo(cls)
+        thread_side = info.thread_side()
+        if not thread_side:
+            return
+        safe_attrs, lock_attrs = info.threadsafe_attrs()
+
+        per_attr: Dict[str, Dict[str, List[_Access]]] = {}
+        for name, fn in list(info.methods.items()):
+            if name == "__init__":
+                continue
+            side = "thread" if name in thread_side else "caller"
+            for access in info.accesses(name, fn, lock_attrs):
+                per_attr.setdefault(access.attr, {}).setdefault(
+                    side, []
+                ).append(access)
+        # Closures are accounted under their own side (a closure in the
+        # thread side may be defined inside a caller-side method, e.g. the
+        # loader's ``produce``).
+        for name, (owner, fn) in info.closures.items():
+            side = "thread" if name in thread_side else (
+                "thread" if owner in thread_side else "caller"
+            )
+            for access in info.accesses(
+                f"{owner}.{name}", fn, lock_attrs
+            ):
+                per_attr.setdefault(access.attr, {}).setdefault(
+                    side, []
+                ).append(access)
+
+        for attr, sides in sorted(per_attr.items()):
+            if attr in safe_attrs or _is_lockish(attr, lock_attrs):
+                continue
+            thread_acc = sides.get("thread", [])
+            caller_acc = sides.get("caller", [])
+            if not thread_acc or not caller_acc:
+                continue
+            unlocked_writes = [
+                a for a in thread_acc + caller_acc
+                if a.is_write and not a.locked
+            ]
+            if not unlocked_writes:
+                continue
+            # The opposite side must actually touch the attribute for a
+            # race to exist.
+            for access in unlocked_writes:
+                opposite = (
+                    caller_acc if access in thread_acc else thread_acc
+                )
+                if not opposite:
+                    continue
+                other = opposite[0]
+                yield ctx.finding(
+                    self.id, access.node,
+                    f"{cls.name}.{attr} written in {access.where!r} "
+                    f"({'thread' if access in thread_acc else 'caller'} "
+                    f"side) without a lock while {other.where!r} "
+                    f"{'writes' if other.is_write else 'reads'} it from "
+                    "the other thread; guard the write with a "
+                    "threading.Lock",
+                    symbol=f"{cls.name}.{attr}",
+                )
+                break  # one finding per attribute is enough

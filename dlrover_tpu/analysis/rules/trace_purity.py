@@ -1,0 +1,113 @@
+"""TRC001 + TRC003: things that must not happen inside a JAX trace.
+
+TRC001 — flax module construction inside a ``lax.scan`` / ``while_loop`` /
+``cond`` / ``shard_map`` body.  The PR 4 incident: ``ChunkStack`` was
+constructed inside the pipeline tick's scan body; flax 0.10 tracks module
+parents at construction time and a module born inside a ``lax`` trace is
+invisible to the enclosing module's scope, so params silently detach
+(or construction outright fails).  The fix — hoist construction out of
+the scan and close over the bound ``apply`` — is the pattern
+``parallel/pipeline.py`` now follows.
+
+TRC003 — wall-clock / RNG host calls inside any traced function.  A
+``time.time()`` or ``random.random()`` executed at trace time bakes a
+different constant into every retrace, so the PR 2 persistent compile
+cache can never hit: two traces of the "same" program hash differently.
+``jax.random`` (explicit keys) is the sanctioned source of randomness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# Host clock / ambient-RNG calls that poison trace determinism.
+IMPURE_CALLS: Set[str] = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "date.today",
+    "random.random", "random.randint", "random.randrange", "random.choice",
+    "random.shuffle", "random.uniform", "random.gauss", "random.sample",
+    "random.seed", "random.getrandbits",
+    "np.random.rand", "np.random.randn", "np.random.randint",
+    "np.random.random", "np.random.uniform", "np.random.normal",
+    "np.random.choice", "np.random.permutation", "np.random.seed",
+    "np.random.default_rng", "numpy.random.default_rng",
+    "uuid.uuid4", "uuid.uuid1", "os.urandom",
+}
+
+
+@register
+class FlaxModuleInScan(Rule):
+    id = "TRC001"
+    name = "flax-module-in-scan"
+    description = (
+        "nn.Module constructed inside a lax.scan/while_loop/cond/"
+        "shard_map body (flax cannot track it; hoist construction out "
+        "and close over the bound apply)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        local_modules = jaxast.flax_module_classes(ctx.tree)
+        scan_fns = jaxast.traced_functions(
+            ctx.tree, jaxast.SCAN_ENTRY_CALLS
+        )
+        for fn_name, fn in scan_fns.items():
+            for node in jaxast.body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = jaxast.call_name(node)
+                constructed = ""
+                if callee in local_modules:
+                    constructed = callee
+                else:
+                    # nn.Dense(...), nn.LayerNorm(...), linen.Dense(...)
+                    parts = callee.split(".")
+                    if (
+                        len(parts) >= 2
+                        and parts[-2] in ("nn", "linen", "flax")
+                        and parts[-1][:1].isupper()
+                        # nn.Module-the-base and metadata helpers are not
+                        # layer constructions.
+                        and parts[-1] not in ("Module", "Partitioned")
+                    ):
+                        constructed = callee
+                if constructed:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"flax module {constructed!r} constructed inside "
+                        f"the traced body {fn_name!r}; hoist it out of the "
+                        "scan and pass its bound apply in",
+                        symbol=f"{fn_name}:{constructed}",
+                    )
+
+
+@register
+class HostImpurityInTrace(Rule):
+    id = "TRC003"
+    name = "host-impurity-in-trace"
+    description = (
+        "wall-clock/ambient-RNG call inside a traced function (bakes a "
+        "per-trace constant; breaks compile-cache determinism)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        traced = jaxast.traced_functions(ctx.tree)
+        for fn_name, fn in traced.items():
+            for node in jaxast.body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = jaxast.call_name(node)
+                if jaxast.name_matches(callee, IMPURE_CALLS):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{callee}() inside traced function {fn_name!r}: "
+                        "the value is baked in at trace time and differs "
+                        "per retrace (use jax.random / pass host values "
+                        "as arguments)",
+                        symbol=f"{fn_name}:{callee}",
+                    )

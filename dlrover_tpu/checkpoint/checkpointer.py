@@ -16,7 +16,6 @@ from typing import Any, Dict, Optional
 
 import jax
 
-from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.checkpoint.engine import CheckpointEngine
 
 

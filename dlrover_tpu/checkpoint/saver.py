@@ -89,6 +89,12 @@ class AsyncCheckpointSaver:
         # world must abort instead of blocking the saver thread for the
         # full commit timeout (which would wedge every later persist).
         self._world_gen = 0
+        # Guards the cross-thread saver state: the (world_hosts, num_hosts,
+        # _world_gen) triple written by ``set_world`` on the agent thread
+        # and snapshotted by the saver thread mid-persist, plus
+        # ``_persisted_step`` (written saver-side, read from the SIGTERM /
+        # membership paths).
+        self._state_lock = threading.Lock()
         AsyncCheckpointSaver._instance = self
 
     # -- lifecycle ------------------------------------------------------------
@@ -226,9 +232,12 @@ class AsyncCheckpointSaver:
         # persist: cleanup keying, committer election AND the commit
         # barrier's abort check.  Reading any of these later would race
         # ``set_world`` from the agent thread.
-        world_gen = self._world_gen
-        num_hosts = self.num_hosts
-        world_hosts = list(self.world_hosts) if self.world_hosts else None
+        with self._state_lock:
+            world_gen = self._world_gen
+            num_hosts = self.num_hosts
+            world_hosts = (
+                list(self.world_hosts) if self.world_hosts else None
+            )
         is_committer = (
             self.host_index == min(world_hosts) if world_hosts
             else self.host_index == 0
@@ -279,7 +288,8 @@ class AsyncCheckpointSaver:
             )
         finally:
             self._lock.release()
-        self._persisted_step = step
+        with self._state_lock:
+            self._persisted_step = step
         self._status.set("persisted_step", step)
         if is_committer:
             self.commit_checkpoint(
@@ -295,9 +305,10 @@ class AsyncCheckpointSaver:
         """Called by the agent after each sealed rendezvous: the commit
         barrier counts done-files of the *sealed* world and is driven by its
         lowest live host id."""
-        self.world_hosts = sorted(world_hosts)
-        self.num_hosts = len(self.world_hosts)
-        self._world_gen += 1
+        with self._state_lock:
+            self.world_hosts = sorted(world_hosts)
+            self.num_hosts = len(self.world_hosts)
+            self._world_gen += 1
         self._status.set("is_committer", self._is_committer())
 
     def _is_committer(self) -> bool:

@@ -24,7 +24,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 SUPPORTED_API_VERSIONS = ("dlrover-tpu/v1",)
 

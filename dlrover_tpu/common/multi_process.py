@@ -23,7 +23,7 @@ import threading
 import time
 import queue as _queue
 from multiprocessing import shared_memory as _mp_shm
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 

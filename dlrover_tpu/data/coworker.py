@@ -20,7 +20,7 @@ import multiprocessing as mp
 import queue as _queue
 import threading
 from multiprocessing import shared_memory
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Tuple
 
 import numpy as np
 

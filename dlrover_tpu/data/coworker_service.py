@@ -22,7 +22,7 @@ import pickle
 import queue
 import threading
 from concurrent import futures
-from typing import Dict, Iterable, Iterator, Optional
+from typing import Dict, Iterable, Iterator
 
 import grpc
 import numpy as np

@@ -16,7 +16,7 @@ from __future__ import annotations
 import collections
 import threading
 import queue as _queue
-from typing import Callable, Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List
 
 import numpy as np
 
@@ -100,6 +100,10 @@ class ElasticDataLoader:
         # thread that outlived its iterator (join timeout) can never keep
         # consuming the shared source on behalf of a successor iterator.
         self._generation = 0
+        # The bump races a stale producer's ``live()`` check without it;
+        # the producer's lock-free read then observes either the old or the
+        # new token, both of which make it exit.
+        self._gen_lock = threading.Lock()
 
     def _indexed_stream(self) -> Iterator:
         """Yields (index, completed_shards) — shards listed once all their
@@ -155,8 +159,9 @@ class ElasticDataLoader:
         never enqueue into, or keep consuming the shared source for, a
         successor iterator.
         """
-        self._generation += 1
-        gen = self._generation
+        with self._gen_lock:
+            self._generation += 1
+            gen = self._generation
         q: _queue.Queue = _queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         stop = threading.Event()

@@ -16,7 +16,6 @@ import threading
 from collections import deque
 from typing import Deque, Iterator, Optional
 
-from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.messages import DatasetShardParams, ShardTask
 
 

@@ -23,7 +23,7 @@ import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.brain import Observation, RunningJobOptimizer

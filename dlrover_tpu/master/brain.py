@@ -21,7 +21,7 @@ import json
 import math
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from dlrover_tpu.common.log import default_logger as logger
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 import pickle
 from concurrent import futures
-from typing import Any, Callable, Dict, Optional, Type
+from typing import Callable, Dict, Type
 
 import grpc
 

@@ -14,7 +14,7 @@ and resumes lookups only once the global version advances.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Set
+from typing import Dict, Set
 
 from dlrover_tpu.common.log import default_logger as logger
 

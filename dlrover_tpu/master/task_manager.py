@@ -19,7 +19,7 @@ import json
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Tuple
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.messages import (

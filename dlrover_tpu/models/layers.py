@@ -10,14 +10,12 @@ module class, so the same model code runs under any strategy.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Any, Callable, Iterable, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Iterable, Optional, Tuple, Union
 
 import flax.linen as nn
 import jax
 import jax.ad_checkpoint
 import jax.numpy as jnp
-import numpy as np
 
 from dlrover_tpu.parallel import rules as lax_rules
 

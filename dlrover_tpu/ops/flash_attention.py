@@ -20,7 +20,7 @@ pad region is masked via an implicit segment id (pad tokens attend nowhere).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.ad_checkpoint

@@ -17,7 +17,6 @@ this by padding each expert's token group (capacity-style or to the block).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

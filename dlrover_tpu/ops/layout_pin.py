@@ -18,7 +18,6 @@ stream the same way (the backward pass has its own layout contagion).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 from jax.experimental import pallas as pl

@@ -17,7 +17,6 @@ tuned at the base width then transfer to the scaled model.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import optax

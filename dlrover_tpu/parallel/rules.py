@@ -26,7 +26,7 @@ Strategy equivalences with the reference (SURVEY.md §2.5):
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Sequence, Tuple, Union
 
 from dlrover_tpu.runtime.mesh import (
     DATA_AXIS,

@@ -24,7 +24,7 @@ function and the engine pieces are separate modules —
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -32,7 +32,6 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 
 

@@ -15,8 +15,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import sys
-import threading
 import time
 from typing import List, Optional, Tuple
 

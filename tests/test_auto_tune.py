@@ -9,7 +9,7 @@ import jax
 import pytest
 
 from dlrover_tpu.auto import auto_tune
-from dlrover_tpu.auto.tune import Candidate, enumerate_candidates
+from dlrover_tpu.auto.tune import enumerate_candidates
 from dlrover_tpu.models.gpt2 import gpt2_config
 from dlrover_tpu.models.llama import moe_llama_config
 

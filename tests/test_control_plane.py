@@ -17,7 +17,6 @@ from dlrover_tpu.master.rdzv_manager import (
     NetworkCheckRendezvousManager,
 )
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
-from dlrover_tpu.master.task_manager import TaskManager
 
 
 @pytest.fixture(scope="module")

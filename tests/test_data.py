@@ -2,7 +2,6 @@
 index-level shard acking (ref SURVEY.md §2.3 elastic sampler/dataloader)."""
 
 import numpy as np
-import pytest
 
 from dlrover_tpu.data.loader import (
     ElasticDataLoader,

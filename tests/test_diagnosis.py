@@ -2,7 +2,6 @@
 
 import time
 
-import pytest
 
 from dlrover_tpu.master import messages as msg
 from dlrover_tpu.master.diagnosis import (

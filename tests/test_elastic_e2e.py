@@ -8,7 +8,6 @@ observe relaunch & resumed step — ``fault_tolerance_exps.md``).
 import os
 import subprocess
 import sys
-import time
 
 import pytest
 

@@ -4,11 +4,11 @@ import os
 import sys
 
 import jax
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import __graft_entry__ as graft  # noqa: E402
-import pytest
 
 
 @pytest.mark.slow  # end-to-end driver dryrun over an 8-device virtual mesh

@@ -9,7 +9,6 @@ import pytest
 from dlrover_tpu.parallel.local_sgd import (
     LocalSGD,
     LocalSGDConfig,
-    average_reduce,
     gta_reduce,
 )
 

@@ -5,7 +5,6 @@ and the no-drop guarantee (round-2 verdict: wire grouped_matmul into MoEMlp).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dlrover_tpu.models.moe import MoEMlp
 

@@ -6,7 +6,6 @@ test injecting a spike (ref ``atorch/atorch/utils/loss_spike_utils.py``,
 ``numberic_checker.py``).
 """
 
-import math
 
 from dlrover_tpu.master.diagnosis import (
     ActionType,

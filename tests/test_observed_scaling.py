@@ -10,12 +10,10 @@ scaler works against a mocked k8s client
 
 import time
 
-import pytest
 
 from dlrover_tpu.master.auto_scaler import JobAutoScaler
 from dlrover_tpu.master.brain import Observation, RunningJobOptimizer
 from dlrover_tpu.master.cloud_launcher import (
-    CloudError,
     CloudNodeLauncher,
     FakeTpuVmClient,
     TpuVmState,

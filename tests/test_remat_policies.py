@@ -12,7 +12,6 @@ memory, and (c) that the named saveables actually exist in the jaxpr.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import logging
 

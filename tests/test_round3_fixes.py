@@ -7,7 +7,6 @@ import threading
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dlrover_tpu.common.storage import CheckpointDirLayout, PosixDiskStorage
 from dlrover_tpu.master.rdzv_manager import ElasticTrainingRendezvousManager

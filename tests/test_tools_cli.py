@@ -45,6 +45,23 @@ def test_job_timeline_help(cpu_child_env):
     assert "--master" in out.stdout and "--out" in out.stdout
 
 
+def test_tracelint_json_smoke(tmp_path, cpu_child_env):
+    """``tracelint --json`` over a trivially clean dir: exit 0 and a
+    well-formed report payload."""
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         str(tmp_path), "--root", str(tmp_path), "--no-baseline",
+         "--json"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert payload["files_checked"] == 1
+    assert payload["exit_code"] == 0
+
+
 def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):
     events = {
         "0": [["step", "span", 10.0, 0.2, {"src": "trainer", "step": 1}],

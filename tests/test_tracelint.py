@@ -1,0 +1,452 @@
+"""Unit tests for the tracelint static-analysis framework.
+
+Every rule gets a positive fixture (a distilled version of the incident
+that motivated it) and a negative fixture (the sanctioned spelling of the
+same pattern), plus round-trips for inline suppressions, the baseline
+file, and the CLI exit-code contract.  Fixtures are analyzed in-process
+via ``run_paths`` — no subprocess per case — so the whole module stays
+fast; the CLI itself is exercised once at the end and by
+``tests/test_lint_gate.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dlrover_tpu.analysis import (
+    all_rules,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+from dlrover_tpu.analysis.engine import (
+    EXIT_CLEAN,
+    EXIT_ERROR,
+    EXIT_FINDINGS,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL_RULE_IDS = {"TRC001", "TRC002", "TRC003", "CMP001", "THR001", "LOG001"}
+
+
+def lint(tmp_path, name, source, select=None, baseline=None):
+    """Write ``source`` under ``tmp_path`` and analyze just that file."""
+    path = tmp_path / name
+    path.write_text(source)
+    return run_paths(
+        [str(path)], select=select, baseline=baseline, root=str(tmp_path)
+    )
+
+
+def rule_ids(report):
+    return sorted({f.rule for f in report.findings})
+
+
+def test_all_rules_registered():
+    assert {r.id for r in all_rules()} == ALL_RULE_IDS
+
+
+# -- TRC001: flax module construction inside a scan-like body --------------
+
+TRC001_BAD = """\
+import flax.linen as nn
+import jax
+
+def outer(params, xs):
+    def body(carry, x):
+        proj = nn.Dense(4)
+        return carry, proj(x)
+    return jax.lax.scan(body, params, xs)
+"""
+
+# Construction under jit (outside scan bodies) is the standard linen
+# idiom — module __init__ is metadata-only there.
+TRC001_OK = """\
+import flax.linen as nn
+import jax
+
+@jax.jit
+def apply(params, x):
+    model = nn.Dense(4)
+    return model.apply(params, x)
+"""
+
+
+def test_trc001_fires_on_module_in_scan_body(tmp_path):
+    report = lint(tmp_path, "m.py", TRC001_BAD)
+    assert rule_ids(report) == ["TRC001"]
+    assert "nn.Dense" in report.findings[0].message
+
+
+def test_trc001_allows_module_under_jit(tmp_path):
+    report = lint(tmp_path, "m.py", TRC001_OK, select=["TRC001"])
+    assert report.findings == []
+
+
+# -- TRC002: host sync on the hot step path --------------------------------
+
+TRC002_BAD = """\
+import jax
+
+class Trainer:
+    def fit(self, batches):
+        for batch in batches:
+            out = self.step(batch)
+            loss = float(out)
+            host = jax.device_get(out)
+        return loss
+"""
+
+TRC002_OK = """\
+import jax
+
+class Trainer:
+    def fit(self, batches):
+        for batch in batches:
+            out = self.step(batch)
+        with pipeline_counters().host_block("metrics_flush"):
+            host = jax.device_get(out)
+        return host
+"""
+
+
+def test_trc002_fires_in_hot_file(tmp_path):
+    report = lint(tmp_path, "elastic_trainer.py", TRC002_BAD)
+    assert rule_ids(report) == ["TRC002"]
+    assert len(report.findings) == 2  # float(out) + device_get
+
+
+def test_trc002_sanctioned_host_block(tmp_path):
+    report = lint(tmp_path, "elastic_trainer.py", TRC002_OK)
+    assert report.findings == []
+
+
+def test_trc002_ignores_cold_files(tmp_path):
+    report = lint(tmp_path, "not_hot.py", TRC002_BAD)
+    assert report.findings == []
+
+
+# -- TRC003: host impurity inside traced code ------------------------------
+
+TRC003_BAD = """\
+import time
+import jax
+
+@jax.jit
+def step(x):
+    return x * time.time()
+"""
+
+TRC003_OK = """\
+import time
+
+def wall_clock():
+    return time.time()
+"""
+
+
+def test_trc003_fires_inside_traced_fn(tmp_path):
+    report = lint(tmp_path, "m.py", TRC003_BAD)
+    assert rule_ids(report) == ["TRC003"]
+    assert "time.time" in report.findings[0].message
+
+
+def test_trc003_allows_host_side_clock(tmp_path):
+    report = lint(tmp_path, "m.py", TRC003_OK, select=["TRC003"])
+    assert report.findings == []
+
+
+# -- CMP001: version-gated APIs without the compat shim --------------------
+
+CMP001_BAD = """\
+import tomllib
+import jax
+
+def activate(mesh):
+    jax.set_mesh(mesh)
+"""
+
+CMP001_OK = """\
+try:
+    import tomllib
+except ImportError:
+    tomllib = None
+import jax
+
+def activate(mesh):
+    if hasattr(jax, "set_mesh"):
+        jax.set_mesh(mesh)
+"""
+
+
+def test_cmp001_fires_on_ungated_uses(tmp_path):
+    report = lint(tmp_path, "m.py", CMP001_BAD)
+    assert rule_ids(report) == ["CMP001"]
+    symbols = {f.symbol for f in report.findings}
+    assert symbols == {"import:tomllib", "jax.set_mesh"}
+
+
+def test_cmp001_allows_probed_uses(tmp_path):
+    report = lint(tmp_path, "m.py", CMP001_OK, select=["CMP001"])
+    assert report.findings == []
+
+
+def test_cmp001_exempts_the_shim_module(tmp_path):
+    report = lint(tmp_path, "mesh.py", CMP001_BAD, select=["CMP001"])
+    # The shim file may touch gated JAX names; the tomllib import gate
+    # still applies everywhere.
+    assert {f.symbol for f in report.findings} == {"import:tomllib"}
+
+
+# -- THR001: cross-thread attribute without a lock -------------------------
+
+THR001_BAD = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.count += 1
+
+    def snapshot(self):
+        return self.count
+"""
+
+THR001_OK = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def snapshot(self):
+        return self.count
+"""
+
+THR001_QUEUE_OK = """\
+import multiprocessing as mp
+import threading
+
+class Feeder:
+    def _start(self):
+        ctx = mp.get_context("spawn")
+        self._task_queue = ctx.Queue(maxsize=4)
+        threading.Thread(target=self._feed, daemon=True).start()
+
+    def _feed(self):
+        while True:
+            self._task_queue.put(1)
+"""
+
+
+def test_thr001_fires_on_unlocked_cross_thread_write(tmp_path):
+    report = lint(tmp_path, "m.py", THR001_BAD)
+    assert rule_ids(report) == ["THR001"]
+    assert report.findings[0].symbol == "Pump.count"
+
+
+def test_thr001_locked_write_is_clean(tmp_path):
+    report = lint(tmp_path, "m.py", THR001_OK, select=["THR001"])
+    assert report.findings == []
+
+
+def test_thr001_mp_queue_attr_is_threadsafe(tmp_path):
+    report = lint(tmp_path, "m.py", THR001_QUEUE_OK, select=["THR001"])
+    assert report.findings == []
+
+
+# -- LOG001: eagerly formatted logging -------------------------------------
+
+LOG001_BAD = """\
+import logging
+
+logger = logging.getLogger(__name__)
+
+def report(step, loss):
+    logger.info(f"step {step}")
+    logger.warning("loss %s" % loss)
+    logger.error("msg: {}".format(step))
+"""
+
+LOG001_OK = """\
+import logging
+
+logger = logging.getLogger(__name__)
+
+def report(step, loss):
+    logger.info("step %d loss %.3f", step, loss)
+"""
+
+
+def test_log001_fires_on_eager_formats(tmp_path):
+    report = lint(tmp_path, "m.py", LOG001_BAD)
+    assert rule_ids(report) == ["LOG001"]
+    assert len(report.findings) == 3
+
+
+def test_log001_lazy_template_is_clean(tmp_path):
+    report = lint(tmp_path, "m.py", LOG001_OK, select=["LOG001"])
+    assert report.findings == []
+
+
+# -- suppressions ----------------------------------------------------------
+
+def test_inline_suppression_silences_one_rule(tmp_path):
+    src = TRC003_BAD.replace(
+        "return x * time.time()",
+        "return x * time.time()  # tracelint: disable=TRC003",
+    )
+    report = lint(tmp_path, "m.py", src)
+    assert report.findings == []
+    assert report.suppressed == 1
+
+
+def test_inline_suppression_disable_all(tmp_path):
+    src = CMP001_BAD.replace(
+        "jax.set_mesh(mesh)",
+        "jax.set_mesh(mesh)  # tracelint: disable=all",
+    )
+    report = lint(tmp_path, "m.py", src, select=["CMP001"])
+    assert {f.symbol for f in report.findings} == {"import:tomllib"}
+    assert report.suppressed == 1
+
+
+def test_suppression_for_other_rule_does_not_silence(tmp_path):
+    src = TRC003_BAD.replace(
+        "return x * time.time()",
+        "return x * time.time()  # tracelint: disable=LOG001",
+    )
+    report = lint(tmp_path, "m.py", src)
+    assert rule_ids(report) == ["TRC003"]
+    assert report.suppressed == 0
+
+
+# -- baseline --------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    report = lint(tmp_path, "m.py", CMP001_BAD)
+    assert len(report.findings) == 2
+
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(str(baseline_path), report.findings)
+    baseline = load_baseline(str(baseline_path))
+    assert len(baseline) == 2
+
+    again = lint(tmp_path, "m.py", CMP001_BAD, baseline=baseline)
+    assert again.findings == []
+    assert again.baselined == 2
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    report = lint(tmp_path, "m.py", CMP001_BAD)
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(str(baseline_path), report.findings)
+    baseline = load_baseline(str(baseline_path))
+
+    drifted = "'''module docstring'''\n\n\n" + CMP001_BAD
+    again = lint(tmp_path, "m.py", drifted, baseline=baseline)
+    assert again.findings == []
+    assert again.baselined == 2
+
+
+# -- engine edge cases -----------------------------------------------------
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    report = lint(tmp_path, "m.py", "def broken(:\n")
+    assert rule_ids(report) == ["ENGINE"]
+    assert report.exit_code == EXIT_FINDINGS
+
+
+def test_unknown_rule_select_raises(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    with pytest.raises(KeyError):
+        run_paths([str(tmp_path)], select=["NOPE99"])
+
+
+def test_findings_sorted_and_keyed(tmp_path):
+    report = lint(tmp_path, "m.py", CMP001_BAD + "\n" + LOG001_BAD)
+    keys = [(f.path, f.line, f.col, f.rule) for f in report.findings]
+    assert keys == sorted(keys)
+    for finding in report.findings:
+        assert finding.baseline_key.startswith(f"{finding.rule}::m.py::")
+
+
+# -- CLI exit codes --------------------------------------------------------
+
+def _run_cli(args, env):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         *args],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+
+
+def test_cli_exit_codes_and_json(tmp_path, cpu_child_env):
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "m.py").write_text(TRC003_BAD)
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "m.py").write_text(TRC003_OK)
+
+    dirty = _run_cli(
+        [str(bad), "--root", str(bad), "--no-baseline", "--json"],
+        cpu_child_env,
+    )
+    assert dirty.returncode == EXIT_FINDINGS, dirty.stderr
+    payload = json.loads(dirty.stdout)
+    assert payload["counts"] == {"TRC003": 1}
+    assert payload["findings"][0]["rule"] == "TRC003"
+
+    clean = _run_cli(
+        [str(good), "--root", str(good), "--no-baseline"], cpu_child_env
+    )
+    assert clean.returncode == EXIT_CLEAN, clean.stderr
+
+    usage = _run_cli(
+        [str(good), "--select", "NOPE99", "--no-baseline"], cpu_child_env
+    )
+    assert usage.returncode == EXIT_ERROR
+
+
+def test_cli_write_baseline_round_trip(tmp_path, cpu_child_env):
+    (tmp_path / "m.py").write_text(CMP001_BAD)
+    baseline = tmp_path / "base.json"
+
+    wrote = _run_cli(
+        [str(tmp_path), "--root", str(tmp_path), "--baseline",
+         str(baseline), "--write-baseline"],
+        cpu_child_env,
+    )
+    assert wrote.returncode == 0, wrote.stderr
+    assert baseline.exists()
+
+    clean = _run_cli(
+        [str(tmp_path), "--root", str(tmp_path), "--baseline",
+         str(baseline)],
+        cpu_child_env,
+    )
+    assert clean.returncode == EXIT_CLEAN, clean.stdout

@@ -7,7 +7,6 @@ mesh shape, so one parameterized test covers the matrix.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -1,0 +1,34 @@
+"""Shared helpers for asserting on ``train_lib.TRACE_COUNTS``.
+
+Retrace regressions (the compile-cache and grad-accum invariants) are
+asserted in several suites; going through one helper keeps the failure
+message uniform and stops each test from poking the counter dict with its
+own off-by-one bookkeeping.
+"""
+
+import contextlib
+
+from dlrover_tpu.trainer import train_lib
+
+
+def snapshot(*names):
+    """Current trace counts for ``names`` (default: ``train_step``)."""
+    names = names or ("train_step",)
+    return {name: train_lib.trace_count(name) for name in names}
+
+
+@contextlib.contextmanager
+def assert_no_retrace(*names):
+    """Assert the wrapped block triggers ZERO fresh traces of ``names``.
+
+    Use after a warm-up step has already paid the first compilation::
+
+        with trace_asserts.assert_no_retrace("train_step", "init"):
+            trainer.fit(more_batches, max_steps=2)
+    """
+    before = snapshot(*names)
+    yield before
+    after = snapshot(*before)
+    assert after == before, (
+        f"unexpected retrace: before={before} after={after}"
+    )

@@ -3,7 +3,6 @@
 python tools/perf_matmul.py  -> one JSON line per shape.
 """
 import json
-import sys
 import time
 
 import jax

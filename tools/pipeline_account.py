@@ -36,7 +36,6 @@ Prints one JSON document; paste the table into PROFILE.md.
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import sys
 import time

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""tracelint CLI: JAX-aware static analysis over this repo's sources.
+
+Usage::
+
+    python tools/tracelint.py dlrover_tpu            # text report
+    python tools/tracelint.py dlrover_tpu --json     # machine-readable
+    python tools/tracelint.py --list-rules
+    python tools/tracelint.py pkg --select TRC002,THR001
+    python tools/tracelint.py pkg --write-baseline   # grandfather findings
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error (stable; the
+tier-1 gate in ``tests/test_lint_gate.py`` keys on them).
+
+Suppress a single line with ``# tracelint: disable=TRC002`` (comma lists
+and ``disable=all`` work); grandfathered findings live in
+``tracelint_baseline.json`` at the repo root and should carry a reason.
+"""
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tracelint",
+        description="JAX-aware static analysis (trace purity, host "
+        "sync, thread discipline).",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=[],
+        help="files or directories to analyze (default: dlrover_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit a JSON report"
+    )
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: <repo>/tracelint_baseline.json "
+        "when present)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline file",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print registered rules and exit",
+    )
+    parser.add_argument(
+        "--root", default=_REPO,
+        help="root for repo-relative finding paths (default: repo root)",
+    )
+    return parser
+
+
+def main(argv=None) -> int:
+    from dlrover_tpu.analysis import (
+        all_rules,
+        load_baseline,
+        run_paths,
+        write_baseline,
+    )
+    from dlrover_tpu.analysis.engine import DEFAULT_BASELINE, EXIT_ERROR
+
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name}: {rule.description}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "dlrover_tpu")]
+    select = [s for s in args.select.split(",") if s.strip()] or None
+
+    baseline_path = args.baseline or os.path.join(_REPO, DEFAULT_BASELINE)
+    baseline = {}
+    if not args.no_baseline and not args.write_baseline and os.path.exists(
+        baseline_path
+    ):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"tracelint: unreadable baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return EXIT_ERROR
+
+    try:
+        report = run_paths(
+            paths, select=select, baseline=baseline, root=args.root
+        )
+    except KeyError as e:  # unknown rule id
+        print(f"tracelint: {e.args[0]}", file=sys.stderr)
+        return EXIT_ERROR
+
+    if args.write_baseline:
+        write_baseline(baseline_path, report.findings)
+        print(
+            f"tracelint: wrote {len(report.findings)} finding(s) to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    print(report.render_json() if args.json else report.render_text())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

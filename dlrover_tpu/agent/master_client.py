@@ -3,49 +3,53 @@
 Capability ref: ``dlrover/python/elastic_agent/master_client.py:50-443``
 (``join_rendezvous``, ``get_comm_world``, ``report_failures``,
 ``report_heart_beat``, kv_store accessors; every call retried).
+
+Retries ride the shared :class:`~dlrover_tpu.common.retry.RetryPolicy`
+(exponential backoff + full jitter + overall deadline) instead of a bespoke
+``2**attempt`` loop: a master restart no longer synchronizes every agent's
+retry storm, and an agent stops burning its preemption grace window after
+``deadline_s``.  ``grpc.RpcError`` is weather (retryable); a master that
+*answered* with a rejection is a bug (fatal, raised as-is).  The
+``rpc.report`` / ``rpc.get`` fault seams fire before each attempt, so a
+fault plan can script flaky-RPC incidents deterministically.
 """
 
 from __future__ import annotations
 
 import pickle
-import time
 from typing import Dict, Optional
 
 import grpc
 
+from dlrover_tpu.common import faults
+from dlrover_tpu.common.retry import RetryError, RetryPolicy
 from dlrover_tpu.master import messages as msg
 from dlrover_tpu.master.servicer import GET, REPORT
 
 
-def retry(func):
-    def wrapped(self, *args, **kwargs):
-        last = None
-        for attempt in range(self._retries):
-            try:
-                return func(self, *args, **kwargs)
-            except grpc.RpcError as e:
-                last = e
-                if attempt + 1 < self._retries:
-                    time.sleep(min(2 ** attempt, 10))
-        raise ConnectionError(
-            f"master unreachable at {self._addr}: {last}"
-        ) from last
-
-    return wrapped
-
-
 class MasterClient:
+    RPC_TIMEOUT_S = 30.0
+
     def __init__(
         self,
         addr: str,
         node_id: int = 0,
         node_type: str = "worker",
         retries: int = 5,
+        deadline_s: float = 120.0,
     ):
         self._addr = addr
         self.node_id = node_id
         self.node_type = node_type
         self._retries = retries
+        self._policy = RetryPolicy(
+            max_attempts=retries,
+            base_delay_s=0.5,
+            max_delay_s=10.0,
+            deadline_s=deadline_s,
+            retryable=(grpc.RpcError,),
+            name="master_rpc",
+        )
         self._channel = grpc.insecure_channel(addr)
         self._report = self._channel.unary_unary(
             REPORT,
@@ -63,23 +67,43 @@ class MasterClient:
             node_id=self.node_id, node_type=self.node_type, payload=payload
         )
 
-    @retry
-    def report(self, payload) -> msg.Response:
-        response = self._report(self._envelope(payload), timeout=30)
-        if not response.success:
-            raise RuntimeError(
-                f"master rejected {type(payload).__name__}: {response.message}"
-            )
-        return response
+    def _call(self, attempt_fn) -> msg.Response:
+        try:
+            return self._policy.call(attempt_fn)
+        except RetryError as e:
+            raise ConnectionError(
+                f"master unreachable at {self._addr}: {e.last_error}"
+            ) from e
 
-    @retry
-    def get(self, payload) -> msg.Response:
-        response = self._get(self._envelope(payload), timeout=30)
-        if not response.success:
-            raise RuntimeError(
-                f"master failed {type(payload).__name__}: {response.message}"
+    def report(self, payload) -> msg.Response:
+        def attempt() -> msg.Response:
+            faults.fire("rpc.report")
+            response = self._report(
+                self._envelope(payload), timeout=self.RPC_TIMEOUT_S
             )
-        return response
+            if not response.success:
+                raise RuntimeError(
+                    f"master rejected {type(payload).__name__}: "
+                    f"{response.message}"
+                )
+            return response
+
+        return self._call(attempt)
+
+    def get(self, payload) -> msg.Response:
+        def attempt() -> msg.Response:
+            faults.fire("rpc.get")
+            response = self._get(
+                self._envelope(payload), timeout=self.RPC_TIMEOUT_S
+            )
+            if not response.success:
+                raise RuntimeError(
+                    f"master failed {type(payload).__name__}: "
+                    f"{response.message}"
+                )
+            return response
+
+        return self._call(attempt)
 
     def ping(self, timeout: float = 2.0) -> bool:
         try:

@@ -661,6 +661,6 @@ class ElasticAgent:
             self._saver.stop(unlink_shm=job_succeeded)
         try:
             self.telemetry.ship(self.client)
-        except Exception:  # noqa: BLE001 - master may already be gone
-            pass
+        except Exception as e:  # noqa: BLE001 - master may already be gone
+            logger.debug("final telemetry ship skipped: %s", e)
         self.client.close()

@@ -4,6 +4,7 @@ from dlrover_tpu.analysis.rules import (  # noqa: F401  (registration imports)
     compat,
     host_sync,
     logfmt,
+    retry_loops,
     threads,
     trace_purity,
 )

@@ -1,0 +1,124 @@
+"""RTY001: hand-rolled retry loops and silent exception swallows.
+
+The PR 6 (Faultline) incident class: every subsystem had grown its own
+retry idiom — a decorator in the master client, a linear-backoff loop in
+the cloud launcher, a flat-tick loop in the IPC layer — each with its own
+notion of backoff, its own logging, and no deadline.  Those were migrated
+onto :class:`dlrover_tpu.common.retry.RetryPolicy`; this rule keeps new
+ones from growing back.
+
+Two patterns fire:
+
+1. **Hand-rolled retry loop** — a ``while``/``for`` loop containing a
+   ``try`` whose ``except`` handler sleeps (``time.sleep``/``*.sleep``):
+   the catch-sleep-retry signature.  ``common/retry.py`` itself is the
+   one legitimate home for that shape and is exempt.
+2. **Silent swallow** — ``except Exception:`` / bare ``except:`` whose
+   body is only ``pass``/``...``, in the failure-handling tiers
+   (``agent/``, ``master/``, ``checkpoint/``): code that turns a real
+   fault into silence is exactly what Faultline exists to surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: The one module allowed to catch-sleep-retry: the policy itself.
+RETRY_HOME = "common/retry.py"
+
+#: Packages where an ``except Exception: pass`` hides real incidents.
+SWALLOW_SCOPES = ("agent/", "master/", "checkpoint/")
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = jaxast.call_name(node)
+    return name == "sleep" or name.endswith(".sleep")
+
+
+def _handler_sleeps(handler: ast.ExceptHandler) -> bool:
+    return any(_is_sleep_call(n) for n in ast.walk(handler))
+
+
+def _swallows_broadly(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or ``except (Base)Exception:`` (incl. tuples)."""
+    exc = handler.type
+    if exc is None:
+        return True
+    names = []
+    if isinstance(exc, ast.Tuple):
+        names = [jaxast.dotted_name(e) for e in exc.elts]
+    else:
+        names = [jaxast.dotted_name(exc)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _body_is_noop(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ) and stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+@register
+class HandRolledRetry(Rule):
+    id = "RTY001"
+    name = "hand-rolled-retry"
+    description = (
+        "bespoke catch-sleep-retry loop or silent broad-except swallow; "
+        "use common/retry.RetryPolicy (carries backoff, deadline, "
+        "telemetry) or log what was dropped"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.rel_path.replace("\\", "/").endswith(RETRY_HOME):
+            yield from self._check_retry_loops(ctx)
+        yield from self._check_swallows(ctx)
+
+    def _check_retry_loops(self, ctx: FileContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                sleepy = [
+                    h for h in node.handlers if _handler_sleeps(h)
+                ]
+                if not sleepy:
+                    continue
+                yield ctx.finding(
+                    self.id, sleepy[0],
+                    "hand-rolled retry loop (except handler sleeps and "
+                    "the loop re-tries); replace with "
+                    "common/retry.RetryPolicy for uniform backoff, "
+                    "jitter, deadlines and retry telemetry",
+                    symbol=f"retry-loop:{loop.lineno}",
+                )
+                break  # one finding per loop is enough
+
+    def _check_swallows(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.rel_path.replace("\\", "/")
+        if not any(scope in path for scope in SWALLOW_SCOPES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _swallows_broadly(node) and _body_is_noop(node.body):
+                yield ctx.finding(
+                    self.id, node,
+                    "broad except swallows the error with a no-op body; "
+                    "at minimum log what was dropped (Faultline-injected "
+                    "errors vanish here)",
+                    symbol=f"swallow:{node.lineno}",
+                )

@@ -19,6 +19,7 @@ import dataclasses
 import os
 import pickle
 import time
+import zlib
 from enum import Enum
 from typing import Any, Callable, Dict, Optional
 
@@ -35,6 +36,7 @@ from dlrover_tpu.common.storage import (
     CheckpointDirLayout,
     CheckpointStorage,
     get_checkpoint_storage,
+    parse_digest,
 )
 from dlrover_tpu.checkpoint.shm_handler import (
     CheckpointMeta,
@@ -359,10 +361,14 @@ class CheckpointEngine:
                     "step %d host %d: meta or data unreadable", step, host
                 )
                 return None
+            if not self._verify_host_digest(step, host, expected, raw, data):
+                return None
             try:
                 metas[host] = pickle.loads(raw)
             except Exception as e:
                 logger.error("step %d host %d: meta corrupt: %s", step, host, e)
+                return None
+            if not self._verify_shards(step, host, metas[host], data):
                 return None
             datas[host] = data
         # Merge shard records across hosts per tensor path.
@@ -405,6 +411,81 @@ class CheckpointEngine:
             merged[path] = assemble_tensor(combined, block_loader)
         logger.info("restored step %d from %s", step, self.checkpoint_dir)
         return self._materialize(merged, ref_meta, shardings, treedef)
+
+    def _verify_host_digest(
+        self, step: int, host: int, num_hosts: int, raw: bytes, data: bytes
+    ) -> bool:
+        """Check one host's meta+data bytes against its digest sidecar.
+
+        Missing/unparseable digest == legacy (pre-integrity-chain)
+        checkpoint: log and accept — rejecting would strand every
+        checkpoint written before the upgrade.  A *present* digest that
+        mismatches means torn or corrupted bytes: reject the step so the
+        caller's degrade walk falls back to an older verified one.
+        """
+        content = self.storage.read(
+            self.layout.digest_path(step, host, num_hosts), mode="r"
+        )
+        parsed = parse_digest(content)
+        if parsed is None:
+            logger.info(
+                "step %d host %d: no digest sidecar (legacy checkpoint); "
+                "skipping whole-file verification", step, host,
+            )
+            return True
+        meta_crc, data_crc, data_nbytes = parsed
+        if len(data) != data_nbytes:
+            logger.error(
+                "step %d host %d REJECTED: data truncated (%d of %d bytes)",
+                step, host, len(data), data_nbytes,
+            )
+            return False
+        if zlib.crc32(raw) != meta_crc:
+            logger.error(
+                "step %d host %d REJECTED: meta crc mismatch", step, host
+            )
+            return False
+        if zlib.crc32(data) != data_crc:
+            logger.error(
+                "step %d host %d REJECTED: data crc mismatch "
+                "(bit-rot or torn write)", step, host,
+            )
+            return False
+        return True
+
+    def _verify_shards(
+        self, step: int, host: int, meta: CheckpointMeta, data: bytes
+    ) -> bool:
+        """Bounds- and crc-check every shard record against the data blob.
+
+        The bounds check runs even for legacy digest-less checkpoints — a
+        truncated data file would otherwise surface as an uncaught
+        ``np.frombuffer`` ValueError deep inside tensor reassembly instead
+        of a clean degrade to an older step.
+        """
+        view = memoryview(data)
+        for tensor in meta.tensors:
+            for record in tensor.shards:
+                end = record.offset + record.nbytes
+                if record.offset < 0 or end > len(data):
+                    logger.error(
+                        "step %d host %d REJECTED: shard %s [%d:%d) outside "
+                        "data blob of %d bytes",
+                        step, host, tensor.path, record.offset, end, len(data),
+                    )
+                    return False
+                expected_crc = getattr(record, "crc32", None)
+                if expected_crc is None:
+                    continue
+                actual = zlib.crc32(view[record.offset:end])
+                if actual != expected_crc:
+                    logger.error(
+                        "step %d host %d REJECTED: shard %s crc mismatch "
+                        "(%d != %d)",
+                        step, host, tensor.path, actual, expected_crc,
+                    )
+                    return False
+        return True
 
     def _all_local(self, meta: CheckpointMeta) -> bool:
         return all(t.local_covers_global for t in meta.tensors)

@@ -15,8 +15,10 @@ import pickle
 import signal
 import threading
 import time
+import zlib
 from typing import Optional
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import (
     SharedDict,
@@ -28,6 +30,7 @@ from dlrover_tpu.common.storage import (
     CheckpointDirLayout,
     CheckpointStorage,
     KeepLatestStepStrategy,
+    digest_stamp,
     get_checkpoint_storage,
 )
 from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
@@ -266,18 +269,43 @@ class AsyncCheckpointSaver:
             if clean_key not in self._cleaned_steps:
                 self._clean_stale_host_files(step, num_hosts, world_hosts)
                 self._cleaned_steps.add(clean_key)
+            faults.fire("saver.persist", step=step)
+            # Integrity chain: stamp a crc32 into every shard record (and a
+            # whole-file digest sidecar) while the bytes are still in shm —
+            # restore re-computes both, so a bit-flip or truncation anywhere
+            # between here and the restoring host is caught, and the step
+            # degrades to an older verified one instead of feeding the
+            # model torn tensors.  crc cost is off the training path (this
+            # is the async saver thread).
+            data = bytes(self._shm.raw_data(meta))
+            for tensor in meta.tensors:
+                for record in tensor.shards:
+                    record.crc32 = zlib.crc32(
+                        memoryview(data)[
+                            record.offset:record.offset + record.nbytes
+                        ]
+                    )
+            meta_bytes = pickle.dumps(meta)
             self.storage.write(
-                pickle.dumps(meta),
+                meta_bytes,
                 self.layout.meta_path(step, self.host_index, num_hosts),
             )
             self.storage.write(
-                bytes(self._shm.raw_data(meta)),
+                data,
                 self.layout.data_path(step, self.host_index, num_hosts),
+            )
+            self.storage.write(
+                digest_stamp(
+                    zlib.crc32(meta_bytes), zlib.crc32(data), len(data)
+                ),
+                self.layout.digest_path(step, self.host_index, num_hosts),
             )
             # The done marker is world-stamped: the commit barrier only
             # counts markers carrying the sealed world's size, so a stale
             # done file left by a previous world's persist of the same step
             # (same host id, different world) can never satisfy the barrier.
+            # It is written LAST: meta/data/digest are all durable before
+            # the step can count toward the commit barrier.
             self.storage.write(
                 self._done_stamp(num_hosts),
                 self.layout.done_path(step, self.host_index),
@@ -355,7 +383,7 @@ class AsyncCheckpointSaver:
                 if name.endswith(".done"):
                     host = int(name[len("host_"):].split(".")[0])
                     stale = host not in expected
-                elif name.endswith((".meta", ".data")):
+                elif name.endswith((".meta", ".data", ".digest")):
                     host = int(name[len("host_"):].split("_of_")[0])
                     file_n = int(name.split("_of_")[1].split(".")[0])
                     stale = file_n != num_hosts or host not in expected

@@ -42,6 +42,10 @@ class ShardRecord:
     offset: int
     nbytes: int
     shape: Tuple[int, ...]
+    # crc32 of this block's raw bytes, stamped by the saver when the shard
+    # is persisted to durable storage (None in shm / legacy checkpoints —
+    # restore treats a missing digest as "skip verify", never "reject").
+    crc32: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -115,8 +119,11 @@ def pack_pytree(
             if isinstance(block, jax.Array):
                 try:
                     block.copy_to_host_async()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Purely a prefetch optimization — np.asarray below
+                    # still materializes the block synchronously — but a
+                    # backend that rejects async copies is worth one line.
+                    logger.debug("copy_to_host_async unavailable: %s", e)
     tensors: List[TensorMeta] = []
     blocks: List[np.ndarray] = []
     offset = 0

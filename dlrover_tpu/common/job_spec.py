@@ -87,6 +87,20 @@ class CheckpointSpec:
 
 
 @dataclasses.dataclass
+class FaultsSpec:
+    """Faultline chaos plan for the whole job (see common/faults.py).
+
+    ``plan`` uses the ``DLROVER_TPU_FAULTS`` grammar
+    (``"storage.write:error@3;rpc.report:delay=2.0@5,7"``); the master/agent
+    export it into every child process so one spec drives a deterministic
+    chaos run end to end.
+    """
+
+    plan: str = ""
+    seed: int = 0
+
+
+@dataclasses.dataclass
 class TrainerSpec:
     command: List[str] = dataclasses.field(default_factory=list)
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -113,6 +127,7 @@ class ElasticJobSpec:
         default_factory=CheckpointSpec
     )
     trainer: TrainerSpec = dataclasses.field(default_factory=TrainerSpec)
+    faults: FaultsSpec = dataclasses.field(default_factory=FaultsSpec)
 
     def validate(self) -> "ElasticJobSpec":
         if self.api_version not in SUPPORTED_API_VERSIONS:
@@ -132,6 +147,15 @@ class ElasticJobSpec:
             )
         if not self.job_name:
             raise JobSpecError("job_name must be non-empty")
+        if self.faults.plan:
+            # Parse eagerly: a malformed chaos plan must fail at spec load,
+            # not hours later when the first scheduled fault would fire.
+            from dlrover_tpu.common import faults as _faults
+
+            try:
+                _faults.parse_plan(self.faults.plan)
+            except ValueError as e:
+                raise JobSpecError(f"[faults].plan invalid: {e}") from e
         coerced = {}
         for key, value in self.trainer.env.items():
             # TOML/YAML naturally parse `OMP_NUM_THREADS = 4` as an int;
@@ -158,6 +182,7 @@ _SECTIONS = {
     "brain": BrainSpec,
     "checkpoint": CheckpointSpec,
     "trainer": TrainerSpec,
+    "faults": FaultsSpec,
 }
 
 

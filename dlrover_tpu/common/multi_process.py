@@ -42,19 +42,32 @@ def _socket_path(kind: str, name: str) -> str:
 
 
 def retry_socket(func):
-    """Retry transient connection failures (server mid-restart)."""
+    """Retry transient connection failures (server mid-restart).
+
+    Rides the shared RetryPolicy (flat 0.1s ticks — the server is on the
+    same host, exponential backoff buys nothing here) and keeps the
+    historical ``TimeoutError`` contract for callers.
+    """
 
     def wrapped(self, *args, **kwargs):
-        last = None
-        for _ in range(self._retries):
-            try:
-                return func(self, *args, **kwargs)
-            except (ConnectionError, FileNotFoundError, socket.timeout) as e:
-                last = e
-                time.sleep(0.1)
-        raise TimeoutError(
-            f"cannot reach {self._path} after {self._retries} tries: {last}"
+        from dlrover_tpu.common.retry import RetryError, RetryPolicy
+
+        policy = RetryPolicy(
+            max_attempts=self._retries,
+            base_delay_s=0.1,
+            max_delay_s=0.1,
+            jitter=False,
+            retryable=(ConnectionError, FileNotFoundError, socket.timeout),
+            name=f"ipc:{os.path.basename(self._path)}",
+            quiet=True,
         )
+        try:
+            return policy.call(func, self, *args, **kwargs)
+        except RetryError as e:
+            raise TimeoutError(
+                f"cannot reach {self._path} after {self._retries} tries: "
+                f"{e.last_error}"
+            ) from e
 
     return wrapped
 

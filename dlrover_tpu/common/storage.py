@@ -16,6 +16,8 @@ from abc import ABC, abstractmethod
 from typing import List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common import faults
+from dlrover_tpu.common.retry import RetryPolicy
 
 
 class CheckpointDeletionStrategy(ABC):
@@ -82,9 +84,32 @@ class CheckpointStorage(ABC):
 
 
 class PosixDiskStorage(CheckpointStorage):
-    """Local disk / NFS / gcsfuse-mounted bucket."""
+    """Local disk / NFS / gcsfuse-mounted bucket.
+
+    Writes are torn-write-proof: content lands in a same-directory temp
+    file, is fsync'd, then atomically ``os.replace``d into place — a
+    preemption mid-write leaves either the old file or nothing, never a
+    truncated shard.  Transient I/O errors (NFS/gcsfuse blips surface as
+    ``OSError``) are retried on a short jittered policy; injected faults
+    from the ``storage.write``/``storage.read`` seams are NOT retried
+    here — they model failures the *caller's* recovery path must absorb.
+    """
+
+    # Short budget: checkpoint persists run off the training path, but a
+    # mount that stays broken for >~2s should fail the persist (the saver
+    # logs it and the next save retries whole) rather than wedge the
+    # saver thread.
+    _io_policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.1, max_delay_s=1.0,
+        retryable=(OSError,), fatal=(faults.FaultInjected,), name="storage_io",
+    )
 
     def write(self, content, path: str) -> None:
+        faults.fire("storage.write", path=os.path.basename(path))
+        self._io_policy.call(self._write_once, content, path)
+
+    @staticmethod
+    def _write_once(content, path: str) -> None:
         mode = "wb" if isinstance(content, (bytes, memoryview)) else "w"
         tmp = path + ".tmp"
         with open(tmp, mode) as f:
@@ -94,6 +119,11 @@ class PosixDiskStorage(CheckpointStorage):
         os.replace(tmp, path)
 
     def read(self, path: str, mode: str = "rb"):
+        faults.fire("storage.read", path=os.path.basename(path))
+        return self._io_policy.call(self._read_once, path, mode)
+
+    @staticmethod
+    def _read_once(path: str, mode: str):
         if not os.path.exists(path):
             return None
         with open(path, mode) as f:
@@ -118,6 +148,35 @@ class PosixDiskStorage(CheckpointStorage):
             pass
 
 
+def digest_stamp(meta_crc: int, data_crc: int, data_nbytes: int) -> str:
+    """Serialize one host's checkpoint digest sidecar (crc32 of the meta
+    pickle, crc32 of the raw data bytes, and the data length so plain
+    truncation is caught before any crc is computed)."""
+    return f"v1 meta_crc32={meta_crc} data_crc32={data_crc} " \
+           f"data_nbytes={data_nbytes}"
+
+
+def parse_digest(content: Optional[str]):
+    """Parse a digest sidecar -> (meta_crc, data_crc, data_nbytes) or None
+    (missing/unreadable digests mean "legacy checkpoint, skip verify" —
+    never "reject")."""
+    if not content:
+        return None
+    fields = {}
+    parts = content.split()
+    if not parts or parts[0] != "v1":
+        return None
+    try:
+        for part in parts[1:]:
+            key, _, value = part.partition("=")
+            fields[key] = int(value)
+        return (
+            fields["meta_crc32"], fields["data_crc32"], fields["data_nbytes"]
+        )
+    except (KeyError, ValueError):
+        return None
+
+
 def get_checkpoint_storage(
     deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
 ) -> CheckpointStorage:
@@ -132,6 +191,7 @@ class CheckpointDirLayout:
       step_{N}/
         host_{i}_of_{n}.meta      <- pickled tensor index for host i
         host_{i}_of_{n}.data      <- raw tensor bytes for host i
+        host_{i}_of_{n}.digest    <- crc32 stamp over meta+data (integrity)
         host_{i}.done             <- per-host done marker
     """
 
@@ -153,6 +213,11 @@ class CheckpointDirLayout:
             self.step_dir(step), f"host_{host}_of_{num_hosts}.data"
         )
 
+    def digest_path(self, step: int, host: int, num_hosts: int) -> str:
+        return os.path.join(
+            self.step_dir(step), f"host_{host}_of_{num_hosts}.digest"
+        )
+
     def done_path(self, step: int, host: int) -> str:
         return os.path.join(self.step_dir(step), f"host_{host}.done")
 
@@ -166,8 +231,24 @@ class CheckpointDirLayout:
         try:
             return int(content.strip())
         except ValueError:
-            logger.warning("corrupt tracker file: %r", content)
-            return -1
+            # A torn/garbage tracker must not take every committed step
+            # down with it: fall back to scanning the step directories for
+            # the newest one that actually finished (has done markers).
+            logger.warning(
+                "corrupt tracker file %r; falling back to directory scan",
+                content,
+            )
+            return self.scan_latest_complete(storage)
+
+    def scan_latest_complete(self, storage: CheckpointStorage) -> int:
+        """Newest step directory containing at least one done marker —
+        the tracker-less estimate of the last committed step."""
+        for step in sorted(self.committed_steps(storage), reverse=True):
+            names = storage.listdir(self.step_dir(step))
+            if any(n.startswith("host_") and n.endswith(".done")
+                   for n in names):
+                return step
+        return -1
 
     def committed_steps(self, storage: CheckpointStorage) -> List[int]:
         steps = []

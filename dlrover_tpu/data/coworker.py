@@ -24,7 +24,26 @@ from typing import Callable, Dict, Iterator, List, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryPolicy
+
+# Sample building touches the data source (remote filesystem, tokenizer
+# service): transient hiccups get a few fast retries before the batch is
+# declared dead and surfaced to the consumer.  The ``coworker.fetch`` seam
+# lets a fault plan script exactly those hiccups.
+_FETCH_POLICY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.05, max_delay_s=0.5, name="coworker_fetch",
+)
+
+
+def _build_batch(sample_fn, indices) -> Dict[str, np.ndarray]:
+    faults.fire("coworker.fetch")
+    batch = [sample_fn(i) for i in indices]
+    return {
+        key: np.stack([s[key] for s in batch])
+        for key in batch[0]
+    }
 
 
 def _worker_main(
@@ -47,11 +66,9 @@ def _worker_main(
             seq, indices = task
             slot = None
             try:
-                batch = [sample_fn(i) for i in indices]
-                collated = {
-                    key: np.stack([s[key] for s in batch])
-                    for key in batch[0]
-                }
+                collated = _FETCH_POLICY.call(
+                    _build_batch, sample_fn, indices
+                )
                 slot = free_queue.get()
                 buf = slots[slot].buf
                 offset = 0

@@ -29,6 +29,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryAborted, RetryError, RetryPolicy
 from dlrover_tpu.master.node_manager import NodeLauncher
 
 
@@ -296,20 +297,23 @@ class CloudNodeLauncher(NodeLauncher):
                     self.node_failed_hook(node_id, str(e))
 
     def _create_with_retry(self, node_id: int):
+        """One launch request, driven to completion by the shared
+        RetryPolicy: bounded jittered attempts, abortable backoff (the
+        stop event's ``wait`` is the sleep, so shutdown never blocks on a
+        backoff window), and an abort check so a node retired mid-backoff
+        is abandoned instead of leaking an untracked, billing VM.
+        """
         name = self.instance_name(node_id)
-        last_err: Optional[CloudError] = None
         with self._wanted_mu:
             gen = self._generation.get(node_id, 0)
-        for attempt in range(self.CREATE_RETRIES):
+
+        def abandoned() -> bool:
+            if self._stop.is_set():
+                return True
             with self._wanted_mu:
-                if node_id not in self._wanted:
-                    # Retired during a backoff window: creating now would
-                    # leak an untracked, billing VM.
-                    logger.info(
-                        "cloud launcher: abandoning create of retired "
-                        "node %d", node_id,
-                    )
-                    return
+                return node_id not in self._wanted
+
+        def attempt():
             existing = self.client.get_node(name)
             if existing is not None and existing["state"] in (
                 TpuVmState.CREATING, TpuVmState.READY
@@ -319,7 +323,6 @@ class CloudNodeLauncher(NodeLauncher):
                 # report a healthy VM as failed.
                 logger.info("cloud launcher: %s already %s", name,
                             existing["state"])
-                self._mark_landed(node_id, gen)
                 return
             if existing is not None:
                 # A dead VM (PREEMPTED/TERMINATED) holds the name on some
@@ -328,29 +331,40 @@ class CloudNodeLauncher(NodeLauncher):
                     self.client.delete_node(name)
                 except CloudError:
                     pass
-            try:
-                self.client.create_node(
-                    name,
-                    accelerator_type=self.accelerator_type,
-                    runtime_version=self.runtime_version,
-                    metadata={
-                        "dlrover-master-addr": self.master_addr,
-                        "dlrover-node-id": str(node_id),
-                        "dlrover-job": self.job_name,
-                    },
-                )
-                logger.info("cloud launcher: creating %s (%s)", name,
-                            self.accelerator_type)
-                self._mark_landed(node_id, gen)
-                return
-            except CloudError as e:
-                last_err = e
-                logger.warning(
-                    "cloud launcher: create %s attempt %d/%d failed: %s",
-                    name, attempt + 1, self.CREATE_RETRIES, e,
-                )
-                if self._stop.wait(self.RETRY_BACKOFF_S * (attempt + 1)):
-                    return
+            self.client.create_node(
+                name,
+                accelerator_type=self.accelerator_type,
+                runtime_version=self.runtime_version,
+                metadata={
+                    "dlrover-master-addr": self.master_addr,
+                    "dlrover-node-id": str(node_id),
+                    "dlrover-job": self.job_name,
+                },
+            )
+            logger.info("cloud launcher: creating %s (%s)", name,
+                        self.accelerator_type)
+
+        policy = RetryPolicy(
+            max_attempts=self.CREATE_RETRIES,
+            base_delay_s=self.RETRY_BACKOFF_S,
+            max_delay_s=max(self.RETRY_BACKOFF_S * 4, 10.0),
+            retryable=(CloudError,),
+            sleep=self._stop.wait,
+            abort=abandoned,
+            name=f"create:{name}",
+        )
+        try:
+            policy.call(attempt)
+            self._mark_landed(node_id, gen)
+            return
+        except RetryAborted:
+            logger.info(
+                "cloud launcher: abandoning create of retired node %d",
+                node_id,
+            )
+            return
+        except RetryError as e:
+            last_err = e.last_error
         # One final state check: the last attempt may have landed.
         existing = self.client.get_node(name)
         if existing is not None and existing["state"] in (

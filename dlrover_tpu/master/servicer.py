@@ -239,6 +239,21 @@ class MasterServicer:
             return
         node = p.node_id if p.node_id >= 0 else env.node_id
         self.timeline.add_events(node, p.events)
+        if self.speed_monitor is not None:
+            # Injected-fault events feed the Faultline ledger: a chaos
+            # run's lost time is attributed to the fault plan, not to the
+            # job.  Wire events are (name, kind, t_wall, duration_s, attrs).
+            for ev in p.events:
+                try:
+                    name, _, _, duration_s, attrs = ev
+                except (TypeError, ValueError):
+                    continue
+                if name == "fault" and isinstance(attrs, dict):
+                    self.speed_monitor.record_fault(
+                        str(attrs.get("seam", "?")),
+                        str(attrs.get("kind", "")),
+                        float(duration_s or 0.0),
+                    )
         if p.dropped:
             logger.warning(
                 "node %d telemetry ring overwrote %d events before this "

@@ -39,6 +39,12 @@ class SpeedMonitor:
         self._compile_events = 0
         self._restart_compiles = 0
         self._cached_compiles = 0
+        # Faultline ledger: injected-fault telemetry events, so chaos-run
+        # goodput attributes lost time to the fault plan instead of
+        # counting it as unexplained downtime.
+        self._fault_events = 0
+        self._fault_lost_s = 0.0
+        self._faults_by_seam: Dict[str, int] = {}
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -84,6 +90,27 @@ class SpeedMonitor:
                 self._restart_compile_s += seconds
             if cached:
                 self._cached_compiles += 1
+
+    def record_fault(self, seam: str, kind: str = "", lost_s: float = 0.0):
+        """One injected fault (from a node's ``fault`` telemetry event).
+
+        ``lost_s`` is the scripted delay for delay-kind faults; error-kind
+        faults book 0 here (their cost shows up as retries/restarts, which
+        the goodput ledger already accounts).
+        """
+        with self._lock:
+            self._fault_events += 1
+            self._fault_lost_s += max(0.0, lost_s)
+            key = f"{seam}:{kind}" if kind else seam
+            self._faults_by_seam[key] = self._faults_by_seam.get(key, 0) + 1
+
+    def fault_ledger(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "fault_events": self._fault_events,
+                "fault_lost_s": self._fault_lost_s,
+                "by_seam": dict(self._faults_by_seam),
+            }
 
     def compile_ledger(self) -> Dict[str, float]:
         with self._lock:

@@ -233,6 +233,13 @@ class JobTimeline:
                   "compile seconds paid on restarts (cache misses)")
             gauge("dlrover_compile_events_total", ledger["compile_events"])
             gauge("dlrover_cached_compiles_total", ledger["cached_compiles"])
+            fault_ledger = speed_monitor.fault_ledger()
+            gauge("dlrover_injected_faults_total",
+                  fault_ledger["fault_events"],
+                  "Faultline-injected faults reported via telemetry")
+            gauge("dlrover_injected_fault_seconds_total",
+                  fault_ledger["fault_lost_s"],
+                  "wall seconds lost to injected delay faults")
             anomalies = speed_monitor.recent_anomalies()
             kinds: Counter = Counter(
                 encoded.split("@", 1)[0] for _, _, encoded in anomalies

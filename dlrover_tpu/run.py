@@ -253,6 +253,17 @@ def run(argv: Optional[List[str]] = None) -> int:
     if args.spec is not None and args.spec.trainer.env:
         # The agent hands its own environment to the trainer subprocess.
         os.environ.update(args.spec.trainer.env)
+    if args.spec is not None and getattr(args.spec, "faults", None) and (
+        args.spec.faults.plan
+    ):
+        # Arm Faultline in this process AND every child (agents hand their
+        # env to trainer subprocesses): one spec drives a deterministic
+        # chaos run across the whole job.
+        from dlrover_tpu.common import faults
+
+        os.environ[faults.ENV_PLAN] = args.spec.faults.plan
+        os.environ[faults.ENV_SEED] = str(args.spec.faults.seed)
+        faults.configure_from_env()
     local_master = None
     if args.standalone or not args.master:
         local_master, master_addr = _launch_local_master(

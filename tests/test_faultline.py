@@ -1,0 +1,418 @@
+"""Faultline: fault-injection fabric, retry policy, checkpoint integrity.
+
+Three tiers, mirroring the PR's layers:
+
+1. the fault registry itself — plan grammar, deterministic seeded
+   schedules, the disabled fast path, telemetry booking;
+2. ``common/retry.py`` — backoff/jitter/deadline/classification units and
+   the circuit breaker;
+3. the checkpoint integrity chain — a corruption matrix (truncated shard,
+   bit-flipped shard/meta, missing meta, torn tracker, injected
+   storage.write error mid-save) where every case must degrade to the
+   last *verified* step, plus a fast in-process ElasticTrainer chaos run.
+"""
+
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import faults, telemetry
+from dlrover_tpu.common.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryAborted,
+    RetryError,
+    RetryPolicy,
+)
+from dlrover_tpu.common.storage import (
+    CheckpointDirLayout,
+    PosixDiskStorage,
+    digest_stamp,
+    parse_digest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Unique shm/job tag + socket dir per test, and no fault plan leaks
+    into (or out of) any test."""
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"fl{os.getpid()}_{tmp_path.name}")
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- tier 1: the fault registry -----------------------------------------------
+
+
+def test_plan_grammar_accepts_the_documented_forms():
+    rules = faults.parse_plan(
+        "storage.write:error@3;rpc.report:delay=2.0@5,7;"
+        "coworker.fetch:error@every:4;rpc.get:error@p=0.25;"
+        "backend.init:error"
+    )
+    by_seam = {r.seam: r for r in rules}
+    assert by_seam["storage.write"].hits == {3}
+    assert by_seam["rpc.report"].kind == "delay"
+    assert by_seam["rpc.report"].delay_s == 2.0
+    assert by_seam["rpc.report"].hits == {5, 7}
+    assert by_seam["coworker.fetch"].every == 4
+    assert by_seam["rpc.get"].prob == 0.25
+    assert by_seam["backend.init"].should_fire(1, random.Random(0))
+
+
+@pytest.mark.parametrize("bad", [
+    "storage.write",                 # no kind
+    "storage.write:explode",         # unknown kind
+    "storage.write:delay=abc",       # non-numeric delay
+    "storage.write:error@0",         # hits are 1-based
+    "storage.write:error@every:0",   # non-positive period
+    "storage.write:error@p=1.5",     # probability out of range
+])
+def test_plan_grammar_rejects_malformed_clauses(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+def test_disabled_path_is_a_no_op():
+    assert faults.active() is None
+    faults.fire("storage.write")  # must not raise, sleep, or allocate a plan
+    assert faults.active() is None
+
+
+def test_hit_schedule_fires_exactly_the_listed_hits():
+    faults.configure("rpc.report:error@2,4")
+    fired = []
+    for i in range(1, 6):
+        try:
+            faults.fire("rpc.report")
+        except faults.FaultInjected as e:
+            fired.append((i, e.seam, e.hit))
+    assert fired == [(2, "rpc.report", 2), (4, "rpc.report", 4)]
+    # Other seams are untouched by this plan.
+    faults.fire("storage.write")
+
+
+def test_probabilistic_schedule_is_deterministic_per_seed():
+    def run(seed):
+        faults.configure("rpc.get:error@p=0.5", seed=seed)
+        for _ in range(30):
+            try:
+                faults.fire("rpc.get")
+            except faults.FaultInjected:
+                pass
+        return list(faults.active().fired)
+
+    first = run(7)
+    second = run(7)
+    assert first == second
+    assert 0 < len(first) < 30  # the coin actually flipped both ways
+
+
+def test_fired_fault_is_booked_as_telemetry_event():
+    rec = telemetry.recorder()
+    was = rec.enabled
+    rec.configure(enabled=True)
+    rec.drain()
+    try:
+        faults.configure("rpc.report:delay=0.001@1")
+        faults.fire("rpc.report")
+        events = rec.drain()
+    finally:
+        rec.configure(enabled=was)
+    fault_events = [e for e in events if e[0] == "fault"]
+    assert len(fault_events) == 1
+    _, kind, _, duration_s, attrs = fault_events[0]
+    assert attrs["seam"] == "rpc.report"
+    assert attrs["kind"] == "delay"
+    assert attrs["injected"] is True
+    assert duration_s == pytest.approx(0.001)
+
+
+def test_configure_from_env(monkeypatch):
+    monkeypatch.setenv(faults.ENV_PLAN, "storage.read:error@1")
+    monkeypatch.setenv(faults.ENV_SEED, "3")
+    plan = faults.configure_from_env()
+    assert plan is not None and plan.seed == 3
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("storage.read")
+
+
+# -- tier 2: the retry policy -------------------------------------------------
+
+
+def test_backoff_is_exponential_and_capped():
+    policy = RetryPolicy(base_delay_s=0.5, max_delay_s=4.0, jitter=False)
+    assert [policy.backoff_s(a) for a in (1, 2, 3, 4, 5)] == [
+        0.5, 1.0, 2.0, 4.0, 4.0
+    ]
+
+
+def test_jitter_draws_within_the_backoff_bound():
+    sleeps = []
+    policy = RetryPolicy(
+        max_attempts=5, base_delay_s=1.0, max_delay_s=8.0,
+        rng=random.Random(0), sleep=sleeps.append,
+    )
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise OSError("blip")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert len(sleeps) == 4
+    for attempt, delay in enumerate(sleeps, start=1):
+        assert 0.0 <= delay <= policy.backoff_s(attempt)
+
+
+def test_exhausted_attempts_raise_retry_error_with_cause():
+    policy = RetryPolicy(max_attempts=3, sleep=lambda _s: None, name="unit")
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RetryError) as exc:
+        policy.call(always)
+    assert exc.value.attempts == 3
+    assert isinstance(exc.value.last_error, OSError)
+
+
+def test_deadline_stops_before_max_attempts():
+    policy = RetryPolicy(
+        max_attempts=100, deadline_s=0.0, sleep=lambda _s: None
+    )
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(RetryError):
+        policy.call(always)
+    assert calls["n"] == 1  # budget spent: no second attempt
+
+
+def test_fatal_and_unlisted_exceptions_raise_through():
+    policy = RetryPolicy(
+        retryable=(OSError,), fatal=(PermissionError,),
+        sleep=lambda _s: None,
+    )
+    with pytest.raises(PermissionError):  # fatal beats retryable
+        policy.call(lambda: (_ for _ in ()).throw(PermissionError("no")))
+    with pytest.raises(KeyError):  # not in retryable at all
+        policy.call(lambda: (_ for _ in ()).throw(KeyError("k")))
+
+
+def test_fault_injected_is_retryable_by_default():
+    policy = RetryPolicy(
+        max_attempts=3, retryable=(ConnectionError,), sleep=lambda _s: None
+    )
+    calls = {"n": 0}
+
+    def injected_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise faults.FaultInjected("rpc.report", 1)
+        return "recovered"
+
+    assert policy.call(injected_once) == "recovered"
+
+
+def test_on_retry_hook_sees_attempt_error_delay():
+    seen = []
+    policy = RetryPolicy(
+        max_attempts=3, jitter=False, base_delay_s=0.25,
+        sleep=lambda _s: None,
+        on_retry=lambda a, e, d: seen.append((a, type(e).__name__, d)),
+    )
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("blip")
+
+    policy.call(flaky)
+    assert seen == [(1, "OSError", 0.25), (2, "OSError", 0.5)]
+
+
+def test_abort_and_truthy_sleep_raise_retry_aborted():
+    aborting = RetryPolicy(abort=lambda: True, sleep=lambda _s: None)
+    with pytest.raises(RetryAborted):
+        aborting.call(lambda: "never reached")
+
+    stop_mid_wait = RetryPolicy(max_attempts=5, sleep=lambda _s: True)
+    with pytest.raises(RetryAborted):  # Event.wait returned set() mid-backoff
+        stop_mid_wait.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    # RetryAborted must be catchable as RetryError (subclass contract).
+    assert issubclass(RetryAborted, RetryError)
+
+
+def test_circuit_breaker_open_halfopen_close_cycle():
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(
+        failure_threshold=2, reset_after_s=10.0, clock=lambda: clock["t"]
+    )
+    assert breaker.state == "closed"
+    for _ in range(2):
+        with pytest.raises(OSError):
+            breaker.call(lambda: (_ for _ in ()).throw(OSError("down")))
+    assert breaker.state == "open"
+    with pytest.raises(CircuitOpenError):
+        breaker.call(lambda: "blocked")
+    clock["t"] = 11.0
+    assert breaker.state == "half-open"
+    assert breaker.call(lambda: "probe") == "probe"  # one probe allowed
+    assert breaker.state == "closed"
+
+
+# -- tier 3: the checkpoint integrity chain -----------------------------------
+
+
+def _saved_engine(tmp_path):
+    """Two committed steps (10 -> 1.0s, 20 -> 2.0s) on real storage."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    saver.start()
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    assert engine.save_to_storage(10, {"w": jnp.full((3,), 1.0)})
+    assert engine.wait_saver(timeout=30)
+    assert engine.save_to_storage(20, {"w": jnp.full((3,), 2.0)})
+    assert engine.wait_saver(timeout=30)
+    return saver, engine, CheckpointDirLayout(ckpt_dir)
+
+
+def _flip_byte(path, offset=0):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def _restore(engine):
+    treedef = jax.tree_util.tree_structure({"w": jnp.zeros((3,))})
+    engine._shm.close(unlink=True)
+    return engine.load_from_storage(treedef=treedef)
+
+
+@pytest.mark.parametrize("corrupt", [
+    "truncate_data", "bitflip_data", "missing_meta", "bitflip_meta",
+])
+def test_corruption_matrix_degrades_to_last_verified_step(tmp_path, corrupt):
+    saver, engine, layout = _saved_engine(tmp_path)
+    data_path = layout.data_path(20, 0, 1)
+    meta_path = layout.meta_path(20, 0, 1)
+    if corrupt == "truncate_data":
+        size = os.path.getsize(data_path)
+        with open(data_path, "r+b") as f:
+            f.truncate(size // 2)
+    elif corrupt == "bitflip_data":
+        _flip_byte(data_path, offset=3)
+    elif corrupt == "missing_meta":
+        os.remove(meta_path)
+    elif corrupt == "bitflip_meta":
+        _flip_byte(meta_path, offset=1)
+    step, loaded = _restore(engine)
+    assert step == 10, f"{corrupt}: landed on {step}, not the verified 10"
+    np.testing.assert_allclose(loaded["w"], np.full((3,), 1.0))
+    saver.stop()
+
+
+def test_torn_tracker_falls_back_to_directory_scan(tmp_path):
+    saver, engine, layout = _saved_engine(tmp_path)
+    with open(layout.tracker_path(), "w") as f:
+        f.write("\x00garbage\xff")
+    assert layout.latest_step(PosixDiskStorage()) == 20
+    step, loaded = _restore(engine)
+    assert step == 20  # the data is fine; only the tracker was torn
+    np.testing.assert_allclose(loaded["w"], np.full((3,), 2.0))
+    saver.stop()
+
+
+def test_injected_write_error_mid_save_keeps_last_verified_step(tmp_path):
+    saver, engine, layout = _saved_engine(tmp_path)
+    # The 1st storage.write of the next persist (the meta file) raises:
+    # the saver logs the failed persist, step 30 never reaches the commit
+    # barrier, and restore lands on the last verified step.
+    faults.configure("storage.write:error@1")
+    assert engine.save_to_storage(30, {"w": jnp.full((3,), 3.0)})
+    assert not engine.wait_saver(timeout=2)
+    assert faults.active().fired == [("storage.write", "error", 1)]
+    faults.reset()
+    step, loaded = _restore(engine)
+    assert step == 20
+    np.testing.assert_allclose(loaded["w"], np.full((3,), 2.0))
+    saver.stop()
+
+
+def test_digest_stamp_roundtrip_and_legacy_none():
+    assert parse_digest(digest_stamp(1, 2, 3)) == (1, 2, 3)
+    assert parse_digest(None) is None
+    assert parse_digest("") is None
+    assert parse_digest("v0 meta_crc32=1") is None
+    assert parse_digest("v1 nonsense") is None
+
+
+def test_legacy_checkpoint_without_digest_still_restores(tmp_path):
+    saver, engine, layout = _saved_engine(tmp_path)
+    # Simulate a pre-integrity-chain checkpoint: no digest sidecar.
+    os.remove(layout.digest_path(20, 0, 1))
+    step, loaded = _restore(engine)
+    assert step == 20
+    np.testing.assert_allclose(loaded["w"], np.full((3,), 2.0))
+    saver.stop()
+
+
+# -- the in-process chaos run -------------------------------------------------
+
+
+def test_elastic_trainer_survives_injected_write_error(tmp_path):
+    """A storage.write fault mid-run must cost one checkpoint, not the
+    job: training completes, later checkpoints commit, and a fresh
+    trainer restores the newest *committed* step."""
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    def loader(batches, seed=0):
+        rng = np.random.default_rng(seed)
+        for _ in range(batches):
+            toks = rng.integers(0, 256, size=(8, 33), dtype=np.int32)
+            yield {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    model = gpt2_config(
+        "124m", num_layers=1, d_model=64, num_heads=2,
+        vocab_size=256, max_seq_len=32,
+    )
+    cfg = TrainerConfig(
+        global_batch_size=8, seq_len=32, learning_rate=1e-2,
+        checkpoint_dir=str(tmp_path / "ckpt"), ckpt_every=2,
+    )
+    # Step 2's persist writes meta (hit 1) then data (hit 2): kill the
+    # data write, so step 2 never commits but steps 4 and 6 do.
+    faults.configure("storage.write:error@2")
+    trainer = ElasticTrainer(model, cfg, client=None)
+    assert trainer.fit(loader(12), max_steps=6) == 6
+    trainer.close()
+    assert ("storage.write", "error", 2) in faults.active().fired
+    faults.reset()
+
+    resumed = ElasticTrainer(model, cfg, client=None)
+    assert resumed.step == 6
+    resumed.close()

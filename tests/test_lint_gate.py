@@ -3,7 +3,7 @@
 The shipped tree must pass its own analyzer: ``tools/tracelint.py`` over
 the ``dlrover_tpu`` package (and ``tools/``) exits 0, with the checked-in
 baseline allowed but expected near-empty.  The gate also asserts the run
-was not vacuous — all six rules registered and the whole package was
+was not vacuous — all seven rules registered and the whole package was
 actually walked — so a rule-registration regression cannot masquerade as
 a clean tree.
 
@@ -24,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
 
 #: Rules the gate expects to be live; extend when adding a rule.
-EXPECTED_RULES = 6
+EXPECTED_RULES = 7
 
 
 def test_tracelint_self_hosting_gate(cpu_child_env):

@@ -45,6 +45,16 @@ def test_job_timeline_help(cpu_child_env):
     assert "--master" in out.stdout and "--out" in out.stdout
 
 
+def test_goodput_bench_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "goodput_bench.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--fault-plan" in out.stdout and "--fault-seed" in out.stdout
+
+
 def test_tracelint_json_smoke(tmp_path, cpu_child_env):
     """``tracelint --json`` over a trivially clean dir: exit 0 and a
     well-formed report payload."""

@@ -30,7 +30,9 @@ from dlrover_tpu.analysis.engine import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ALL_RULE_IDS = {"TRC001", "TRC002", "TRC003", "CMP001", "THR001", "LOG001"}
+ALL_RULE_IDS = {
+    "TRC001", "TRC002", "TRC003", "CMP001", "THR001", "LOG001", "RTY001",
+}
 
 
 def lint(tmp_path, name, source, select=None, baseline=None):
@@ -309,6 +311,83 @@ def test_log001_fires_on_eager_formats(tmp_path):
 
 def test_log001_lazy_template_is_clean(tmp_path):
     report = lint(tmp_path, "m.py", LOG001_OK, select=["LOG001"])
+    assert report.findings == []
+
+
+# -- RTY001: hand-rolled retry loops + silent swallows ---------------------
+
+RTY001_RETRY_LOOP = """\
+import time
+
+def fetch(url):
+    for attempt in range(5):
+        try:
+            return do_fetch(url)
+        except ConnectionError:
+            time.sleep(2 ** attempt)
+    raise RuntimeError("gave up")
+"""
+
+# The sanctioned spelling: no sleep in the handler, the policy owns it.
+RTY001_OK_POLICY = """\
+from dlrover_tpu.common.retry import RetryPolicy
+
+def fetch(url):
+    return RetryPolicy(max_attempts=5).call(do_fetch, url)
+"""
+
+# A poll loop that sleeps OUTSIDE the except handler is not a retry loop.
+RTY001_OK_POLL = """\
+import time
+
+def watch(poll):
+    while True:
+        try:
+            poll()
+        except StopIteration:
+            break
+        time.sleep(1.0)
+"""
+
+RTY001_SWALLOW = """\
+def shutdown(client):
+    try:
+        client.close()
+    except Exception:
+        pass
+"""
+
+
+def test_rty001_fires_on_catch_sleep_retry_loop(tmp_path):
+    report = lint(tmp_path, "m.py", RTY001_RETRY_LOOP, select=["RTY001"])
+    assert rule_ids(report) == ["RTY001"]
+    assert "RetryPolicy" in report.findings[0].message
+
+
+def test_rty001_policy_call_and_poll_loop_are_clean(tmp_path):
+    for src in (RTY001_OK_POLICY, RTY001_OK_POLL):
+        report = lint(tmp_path, "m.py", src, select=["RTY001"])
+        assert report.findings == []
+
+
+def test_rty001_retry_home_module_is_exempt(tmp_path):
+    (tmp_path / "common").mkdir()
+    report = lint(
+        tmp_path, os.path.join("common", "retry.py"),
+        RTY001_RETRY_LOOP, select=["RTY001"],
+    )
+    assert report.findings == []
+
+
+def test_rty001_swallow_fires_only_in_failure_tiers(tmp_path):
+    (tmp_path / "agent").mkdir()
+    report = lint(
+        tmp_path, os.path.join("agent", "m.py"),
+        RTY001_SWALLOW, select=["RTY001"],
+    )
+    assert rule_ids(report) == ["RTY001"]
+    # The same code outside agent/master/checkpoint is tolerated.
+    report = lint(tmp_path, "util.py", RTY001_SWALLOW, select=["RTY001"])
     assert report.findings == []
 
 

@@ -51,6 +51,14 @@ def main() -> int:
     ap.add_argument("--workdir", default="/tmp/dlrover_tpu_goodput")
     ap.add_argument("--out", default="GOODPUT.json")
     ap.add_argument("--target", type=float, default=0.9)
+    ap.add_argument("--fault-plan", default="",
+                    help="Faultline plan (DLROVER_TPU_FAULTS grammar, e.g. "
+                         "'storage.write:error@3;rpc.report:delay=0.5@5'); "
+                         "replaces the wall-clock SIGKILL scheduler with a "
+                         "deterministic, seeded fault schedule so runs are "
+                         "reproducible")
+    ap.add_argument("--fault-seed", type=int, default=0,
+                    help="seed for --fault-plan probabilistic schedules")
     args = ap.parse_args()
 
     from dlrover_tpu.master.job_master import JobMaster
@@ -85,6 +93,15 @@ def main() -> int:
         "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.1",
     })
     env.pop("XLA_FLAGS", None)
+    if args.fault_plan:
+        # Validate up front (a typo'd plan must not burn a bench run) and
+        # hand the schedule to every child; agents re-export it to their
+        # trainer subprocesses, so one flag arms the whole process tree.
+        from dlrover_tpu.common import faults
+
+        faults.parse_plan(args.fault_plan)
+        env[faults.ENV_PLAN] = args.fault_plan
+        env[faults.ENV_SEED] = str(args.fault_seed)
 
     def spawn_agent():
         cmd = [
@@ -108,7 +125,12 @@ def main() -> int:
     t_start = time.monotonic()
     agent = spawn_agent()
     kills = []
-    next_kill = time.monotonic() + args.kill_every
+    # Deterministic mode: injected faults come from the seeded plan, not
+    # from this process's wall clock — disable the SIGKILL scheduler.
+    next_kill = (
+        float("inf") if args.fault_plan
+        else time.monotonic() + args.kill_every
+    )
     mode = 0
     while True:
         rc = agent.poll()
@@ -170,6 +192,8 @@ def main() -> int:
             "final_step": sm.global_step,
             "target_steps": args.steps,
             "kills": kills,
+            "fault_plan": args.fault_plan,
+            "fault_ledger": sm.fault_ledger(),
             "completed": sm.global_step >= args.steps,
         },
     }

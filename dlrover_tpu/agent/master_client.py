@@ -220,6 +220,13 @@ class MasterClient:
     def report_event(self, event: str, detail: str = ""):
         self.report(msg.NodeEventReport(self.node_id, event, detail))
 
+    def report_preemption(self, grace_s: float = 30.0, reason: str = ""):
+        """Tell the master this host is being preempted and how much of
+        its grace window remains — the master drains it (rendezvous
+        eviction, shard requeue, shrink ScalePlan) instead of waiting for
+        the heartbeat timeout."""
+        self.report(msg.PreemptionNotice(self.node_id, grace_s, reason))
+
     def report_telemetry(self, events, dropped: int = 0):
         """Ship one drained telemetry batch (common/telemetry.py wire
         tuples) to the master's job timeline."""

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -78,13 +79,21 @@ class ResourceMonitor:
     """Samples host + device telemetry and reports it to the master."""
 
     def __init__(self, client, interval: float = 30.0,
-                 metrics_file: Optional[str] = None, recorder=None):
+                 metrics_file: Optional[str] = None, recorder=None,
+                 on_preemption=None):
         self._client = client
         self._interval = interval
         self._metrics_file = metrics_file
         # Optional agent telemetry recorder: shipped on the resource
         # cadence as a backstop for the heartbeat drain.
         self._recorder = recorder
+        # Preemption watch: real deployments point DLROVER_TPU_PREEMPT_FILE
+        # at the platform's maintenance-notice path (GCE metadata poller /
+        # node-problem-detector drop file); chaos runs script the notice by
+        # firing the ``preempt.notice`` seam.  Latched: one callback total.
+        self._on_preemption = on_preemption
+        self._preempt_file = os.environ.get("DLROVER_TPU_PREEMPT_FILE", "")
+        self._preempted = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_cpu: Optional[Tuple[float, float]] = None
@@ -121,9 +130,53 @@ class ResourceMonitor:
                 pass
         return out
 
+    def check_preemption(self) -> bool:
+        """One preemption probe; latches and fires the callback on the
+        first detection.  Returns True iff this host has been warned.
+
+        A fired ``preempt.notice`` error fault IS the scripted warning —
+        that's how a Faultline plan preempts a specific host at a specific
+        hit without any platform integration.
+        """
+        if self._preempted:
+            return True
+        if self._on_preemption is None:
+            return False
+        reason = ""
+        try:
+            faults.fire("preempt.notice")
+        except faults.FaultInjected as f:
+            reason = f"faultline:{f.seam}@{f.hit}"
+        if not reason and self._preempt_file and os.path.exists(
+            self._preempt_file
+        ):
+            try:
+                with open(self._preempt_file) as f:
+                    reason = f.read().strip() or "preempt-file"
+            except OSError:
+                reason = "preempt-file"
+        if not reason:
+            return False
+        self._preempted = True
+        logger.warning("preemption notice detected: %s", reason)
+        try:
+            self._on_preemption(reason)
+        except Exception as e:  # noqa: BLE001 - watch must not kill agent
+            logger.warning("preemption callback failed: %s", e)
+        return True
+
     def _run(self):
         self.sample()  # prime the cpu delta
-        while not self._stop.wait(self._interval):
+        # Tick fast enough that a preemption warning is seen within ~1s of
+        # its grace window opening, while resource reports keep their
+        # (much coarser) cadence.
+        tick = min(self._interval, 1.0)
+        next_report = time.monotonic() + self._interval
+        while not self._stop.wait(tick):
+            self.check_preemption()
+            if time.monotonic() < next_report:
+                continue
+            next_report = time.monotonic() + self._interval
             try:
                 s = self.sample()
                 self._client.report_resource(

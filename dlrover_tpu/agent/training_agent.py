@@ -24,8 +24,9 @@ import time
 from enum import Enum
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import faults, telemetry
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryPolicy
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
 from dlrover_tpu.master.rdzv_manager import RendezvousName
@@ -80,6 +81,10 @@ class ElasticLaunchConfig:
     local_world_size: int = 0  # 0 -> discover (local chip count)
     heartbeat_interval: float = 15.0
     resource_report_interval: float = 30.0
+    # Grace window a preemption notice grants before the host vanishes:
+    # the drain (shm flush -> master notice -> trainer stop) must fit
+    # inside it.  Cloud TPU maintenance events give 30-60s.
+    preempt_grace_s: float = 30.0
     # Device-init watchdog (VERDICT r4 #2b): a freshly started trainer
     # that produces no first step report within this bound is stuck below
     # Python (wedged device relay, hung PJRT init) — a failure mode the
@@ -109,11 +114,25 @@ class MasterRendezvousHandler:
     def next_rendezvous(self) -> Dict:
         """Returns {round, world, rank, coordinator}."""
         local_world = self._config.local_world_size or 1
-        self._client.join_rendezvous(
-            self._node_rank, local_world,
-            RendezvousName.TRAINING, self._config.node_unit,
-        )
         deadline = time.monotonic() + self._config.rdzv_timeout
+        def _join():
+            # The ``rdzv.join`` seam scripts a transient join failure
+            # (the flaky-control-plane moment right after a resize);
+            # retries burn the same rendezvous deadline as the poll.
+            faults.fire("rdzv.join")
+            self._client.join_rendezvous(
+                self._node_rank, local_world,
+                RendezvousName.TRAINING, self._config.node_unit,
+            )
+
+        # retryable=() keeps real join errors fatal (master_client already
+        # retries transport); injected faults are always retryable.
+        RetryPolicy(
+            max_attempts=1000, base_delay_s=0.5, max_delay_s=0.5,
+            jitter=False, retryable=(),
+            deadline_s=max(0.1, deadline - time.monotonic()),
+            name="rdzv.join",
+        ).call(_join)
         while time.monotonic() < deadline:
             state = self._client.get_comm_world(
                 self._node_rank, RendezvousName.TRAINING
@@ -180,6 +199,10 @@ class ElasticAgent:
         self._restart_count = 0
         self._current_round = -1
         self._stop = threading.Event()
+        # Preemption drain latch: set by the ResourceMonitor's notice
+        # callback (any thread); the monitor loop runs the actual drain.
+        self._preempt_event = threading.Event()
+        self._preempt_reason = ""
         self._saver: Optional[AsyncCheckpointSaver] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._resource_monitor = None
@@ -549,6 +572,7 @@ class ElasticAgent:
             interval=self.config.resource_report_interval,
             metrics_file=self._metrics_file(),
             recorder=self.telemetry,
+            on_preemption=self.request_preemption_drain,
         )
         self._resource_monitor.start()
         self._start_workers()
@@ -556,9 +580,64 @@ class ElasticAgent:
         self._stop.set()
         return result
 
+    def request_preemption_drain(self, reason: str = ""):
+        """Preemption-notice hook (ResourceMonitor callback, any thread):
+        latch the reason and wake the monitor loop, which runs the drain."""
+        self._preempt_reason = reason or "preempted"
+        self._preempt_event.set()
+
+    def _drain_and_exit(self) -> RunResult:
+        """Graceful preemption drain, bounded by ``preempt_grace_s``:
+
+        1. flush the trainer's latest shm checkpoint to storage — this
+           host's done-file joins the old world's commit barrier, so the
+           shrunk world can cross-world-restore a fully committed step
+           instead of losing it;
+        2. notify the master (rendezvous eviction, shard requeue, shrink
+           ScalePlan happen there — survivors re-form without us);
+        3. stop the trainer inside whatever grace remains.
+        """
+        grace = self.config.preempt_grace_s
+        deadline = time.monotonic() + grace
+        reason = self._preempt_reason
+        logger.warning("preemption drain (grace %.0fs): %s", grace, reason)
+        with self.telemetry.span("drain") as sp:
+            if sp is not None:
+                sp.attrs["reason"] = reason
+                sp.attrs["grace_s"] = grace
+            if self._saver is not None:
+                with self.telemetry.span("drain_flush"):
+                    try:
+                        self._saver.save_shm_to_storage()
+                    except Exception as e:  # noqa: BLE001 - keep draining
+                        logger.warning("drain flush failed: %s", e)
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                self.client.report_preemption(
+                    grace_s=remaining, reason=reason
+                )
+            except ConnectionError:
+                logger.warning("preemption report: master unreachable")
+        try:
+            self.telemetry.ship(self.client)
+        except Exception as e:  # noqa: BLE001 - master may already be gone
+            logger.warning("drain telemetry ship failed: %s", e)
+        self._stop_workers(grace=max(1.0, deadline - time.monotonic()))
+        try:
+            self.client.report_event("preempted", reason)
+        except ConnectionError:
+            pass
+        self._stop.set()
+        return RunResult.STOPPED
+
     def _invoke_run(self) -> RunResult:
         while not self._stop.is_set():
-            time.sleep(self.config.monitor_interval)
+            # The preempt latch doubles as the sleep: a notice wakes the
+            # loop immediately instead of burning monitor_interval of the
+            # grace window.
+            self._preempt_event.wait(self.config.monitor_interval)
+            if self._preempt_event.is_set():
+                return self._drain_and_exit()
             code = self._proc.poll()
             if code is None:
                 if self._membership_changed():

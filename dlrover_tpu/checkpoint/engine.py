@@ -309,13 +309,21 @@ class CheckpointEngine:
         return -1, None
 
     def _load_step_from_storage(self, step: int, shardings, treedef):
-        """Load one step, or None if it is incomplete/corrupt.
+        """Load one step, resharding across saved world sizes when needed.
 
         The host set is discovered from the ``host_{i}_of_{n}.meta`` files
         actually present (node ids are sparse after elastic shrinks — never
-        ``range(num_hosts)``); the step is rejected unless all ``n`` hosts'
-        meta+data are readable and every tensor's shard records fully cover
-        its global shape.
+        ``range(num_hosts)``).  Every *complete* world group (all ``n`` of
+        its hosts' metas present) is a restore candidate: an elastic resize
+        legitimately leaves two self-consistent groups in one step dir
+        (survivors re-persist the step under the new world before the old
+        world's files are cleaned), and each host's meta indexes EVERY
+        tensor's global shape, so any group can be resharded into any
+        target world.  Candidates are walked in deterministic authority
+        order and the first that fully verifies wins; a corrupt
+        authoritative group degrades to the next one, then to older steps.
+        Zero complete groups still rejects — the step is genuinely
+        partial/stale.
         """
         step_dir = self.layout.step_dir(step)
         groups: Dict[int, Dict[int, str]] = {}
@@ -331,26 +339,60 @@ class CheckpointEngine:
         if not groups:
             logger.warning("step %d: no meta files in %s", step, step_dir)
             return None
-        if len(groups) > 1:
-            logger.error(
-                "step %d: meta files from mixed world sizes %s in %s (stale "
-                "files from a previous world survived a re-save)",
-                step, sorted(groups), step_dir,
-            )
         complete = {n: hosts for n, hosts in groups.items() if len(hosts) == n}
-        if len(complete) != 1:
-            # Zero complete groups: the step is genuinely partial.  More than
-            # one: two worlds each left a self-consistent set and nothing
-            # here can tell which one the tracker meant — reject the step so
-            # restore degrades to an older unambiguous one.
+        if not complete:
             logger.error(
-                "step %d not restorable: world-size groups %s, complete %s",
-                step,
-                {n: sorted(h) for n, h in groups.items()},
-                sorted(complete),
+                "step %d not restorable: no complete world group "
+                "(world-size groups %s)",
+                step, {n: sorted(h) for n, h in groups.items()},
             )
             return None
-        expected, host_files = next(iter(complete.items()))
+        if len(groups) > 1:
+            logger.warning(
+                "step %d: meta files from mixed world sizes %s in %s; "
+                "trying complete groups in authority order %s",
+                step, sorted(groups), step_dir,
+                [n for n, _ in self._order_world_groups(step, complete)],
+            )
+        for n, host_files in self._order_world_groups(step, complete):
+            result = self._load_step_group(
+                step, n, host_files, shardings, treedef
+            )
+            if result is not None:
+                return result
+        return None
+
+    def _order_world_groups(self, step: int, complete: Dict[int, Dict]):
+        """Deterministic authority order over complete world groups.
+
+        The freshest signal on storage is the per-host done marker: its
+        world stamp (``ok:{n}``) is overwritten by whichever world
+        persisted the step last, so the group whose hosts' done files
+        agree with it is the one the commit barrier (and tracker) meant.
+        Ties break toward the larger world — arbitrary but stable, and the
+        verify walk rejects a wrong guess anyway.
+        """
+        def authority(item):
+            n, hosts = item
+            stamp = f"ok:{n}"
+            done = 0
+            for host in hosts:
+                content = self.storage.read(
+                    self.layout.done_path(step, host), mode="r"
+                )
+                if content is not None and content.strip() == stamp:
+                    done += 1
+            return (done / n, n)
+
+        return sorted(complete.items(), key=authority, reverse=True)
+
+    def _load_step_group(
+        self, step: int, expected: int, host_files: Dict[int, str],
+        shardings, treedef,
+    ):
+        """Read + verify one complete world group and reshard it into this
+        world; None when any host's bytes fail verification (the caller's
+        walk then tries the next candidate group / an older step)."""
         metas: Dict[int, CheckpointMeta] = {}
         datas: Dict[int, bytes] = {}
         for host in host_files:
@@ -409,7 +451,21 @@ class CheckpointEngine:
                 )
 
             merged[path] = assemble_tensor(combined, block_loader)
-        logger.info("restored step %d from %s", step, self.checkpoint_dir)
+        booked = getattr(ref_meta, "world_size", 0)
+        if booked and booked != expected:
+            logger.warning(
+                "step %d: meta books world %d but filenames say %d "
+                "(shard records drive reassembly; continuing)",
+                step, booked, expected,
+            )
+        if expected != self.num_hosts:
+            logger.info(
+                "cross-world restore: step %d saved by %d hosts -> "
+                "resharded into world of %d hosts",
+                step, expected, self.num_hosts,
+            )
+        else:
+            logger.info("restored step %d from %s", step, self.checkpoint_dir)
         return self._materialize(merged, ref_meta, shardings, treedef)
 
     def _verify_host_digest(

@@ -215,6 +215,7 @@ class AsyncCheckpointSaver:
         restart (or the SIGTERM grace window) for the full commit timeout
         would cost the whole preemption budget.  Peers that are alive all
         persist within seconds, so a healthy world still commits."""
+        faults.fire("saver.flush", host=self.host_index)
         meta = self._shm.load_meta()
         if meta is None:
             return False
@@ -285,6 +286,14 @@ class AsyncCheckpointSaver:
                             record.offset:record.offset + record.nbytes
                         ]
                     )
+            # World booking for cross-world restore: the meta records which
+            # world persisted it, so a restoring world of a different size
+            # can pick the authoritative group in a mixed step dir and
+            # reshard instead of rejecting the step.
+            meta.world_size = num_hosts
+            meta.world_hosts = (
+                tuple(world_hosts) if world_hosts else (self.host_index,)
+            )
             meta_bytes = pickle.dumps(meta)
             self.storage.write(
                 meta_bytes,

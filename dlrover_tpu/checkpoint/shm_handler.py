@@ -67,6 +67,13 @@ class CheckpointMeta:
     created_at: float
     tensors: List[TensorMeta]
     extra: Dict[str, Any]  # small non-array state (pytree def, rng, config)
+    # World booking, stamped by the saver at persist time (0/() in shm and
+    # legacy checkpoints — readers use ``getattr`` with defaults, since old
+    # pickles restore instances lacking these attributes entirely).  Every
+    # host's meta lists EVERY tensor path + global shape, so together with
+    # this booking any target world m can reshard a step saved by n hosts.
+    world_size: int = 0
+    world_hosts: Tuple[int, ...] = ()
 
 
 def _slices_to_index(

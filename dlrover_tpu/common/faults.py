@@ -31,7 +31,8 @@ injected failures instead of blaming the job.
 
 Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``rpc.report``, ``rpc.get``, ``storage.write``, ``storage.read``,
-``saver.persist``, ``backend.init``, ``coworker.fetch``.
+``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
+``preempt.notice``, ``rdzv.join``.
 """
 
 from __future__ import annotations
@@ -55,8 +56,15 @@ KNOWN_SEAMS = (
     "storage.write",
     "storage.read",
     "saver.persist",
+    "saver.flush",
     "backend.init",
     "coworker.fetch",
+    # Elastic-resize seams: a scripted preemption notice (the agent's
+    # monitor treats a fired error here as "this host just got its
+    # preemption warning"), a transient rendezvous-join failure, and the
+    # breakpoint shm->storage flush a draining host races its grace window.
+    "preempt.notice",
+    "rdzv.join",
 )
 
 

@@ -101,6 +101,39 @@ class JobAutoScaler:
         with self._lock:
             return self._target
 
+    def note_preemption(self, node_id: int) -> ScalePlan:
+        """A node announced its own preemption: shrink the target around it
+        and retire it immediately — no cooldown, no heartbeat wait.
+
+        The regular ``decide()`` loop would treat the disappearing node as
+        damage to repair (relaunch toward the old target); a preemption is
+        capacity *leaving*, so the target follows the survivors and the
+        world re-forms smaller.  A later ``set_target`` (operator or brain)
+        can grow it back once capacity returns.
+        """
+        statuses = self.node_manager.statuses(pool="worker")
+        survivors = [
+            n for n, s in statuses.items()
+            if n != node_id
+            and s in (NodeStatus.RUNNING.value, NodeStatus.PENDING.value)
+        ]
+        plan = ScalePlan(
+            target_nodes=len(survivors),
+            delete=[node_id],
+            reason=f"preemption notice from node {node_id}",
+        )
+        self.set_target(len(survivors), reason=plan.reason)
+        self.plans.append(plan)
+        logger.info(
+            "preemption scale plan: delete=%s target=%d",
+            plan.delete, plan.target_nodes,
+        )
+        self.node_manager.retire_node(node_id)
+        if self.retire_hook is not None:
+            self.retire_hook(node_id)
+        self.speed_monitor.reset_running_speed()
+        return plan
+
     def decide(self) -> ScalePlan:
         """Compare live inventory with the target; no side effects."""
         statuses = self.node_manager.statuses(pool="worker")

@@ -141,6 +141,7 @@ class JobMaster:
             kv_store=self.kv_store,
             metrics=self.metrics,
             timeline=self.timeline,
+            auto_scaler=self.auto_scaler,
         )
         self._server = None
         self.port = port

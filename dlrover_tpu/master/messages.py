@@ -206,6 +206,18 @@ class NodeEventReport:
 
 
 @dataclasses.dataclass
+class PreemptionNotice:
+    """Agent-side preemption warning: this host disappears within
+    ``grace_s`` seconds.  The master drains it gracefully — rendezvous
+    eviction, shard requeue, a shrink ScalePlan — instead of paying the
+    heartbeat timeout to discover the death after the fact."""
+
+    node_id: int
+    grace_s: float = 30.0
+    reason: str = ""
+
+
+@dataclasses.dataclass
 class ResourceStats:
     node_id: int
     cpu_percent: float = 0.0

@@ -38,6 +38,7 @@ class MasterServicer:
         paral_config=None,
         metrics=None,
         timeline=None,
+        auto_scaler=None,
     ):
         self.rdzv_managers = rdzv_managers or {}
         self.task_manager = task_manager
@@ -47,6 +48,7 @@ class MasterServicer:
         self.paral_config = paral_config or msg.ParalConfig()
         self.metrics = metrics
         self.timeline = timeline
+        self.auto_scaler = auto_scaler
         from dlrover_tpu.master.sync_service import SyncService
 
         self.sync_service = SyncService()
@@ -77,6 +79,7 @@ class MasterServicer:
             msg.HeartBeat: self._report_heartbeat,
             msg.NodeFailure: self._report_failure,
             msg.NodeEventReport: self._report_event,
+            msg.PreemptionNotice: self._report_preemption,
             msg.ResourceStats: self._report_resource,
             msg.ShardCheckpoint: self._restore_shard_checkpoint,
             msg.TelemetryEvents: self._report_telemetry,
@@ -211,6 +214,43 @@ class MasterServicer:
                 p.node_id, p.error, p.exit_code, p.level
             )
         return "restart"
+
+    def _report_preemption(self, env: msg.Envelope):
+        """A host's grace window is burning: drain it NOW.
+
+        Ordering mirrors ``_report_failure`` (rendezvous eviction first so
+        survivors stop sealing worlds containing the doomed host, then
+        shard requeue), plus the resize bookkeeping that makes the drain
+        observable: the resize ledger opens here and closes on the first
+        step report of the re-formed world, and the shrink ScalePlan goes
+        through the auto-scaler so the resize shows up in its plan history
+        instead of as an unexplained heartbeat death.
+        """
+        p: msg.PreemptionNotice = env.payload
+        logger.warning(
+            "preemption notice from node %d (grace %.0fs): %s",
+            p.node_id, p.grace_s, p.reason or "unspecified",
+        )
+        if self.speed_monitor is not None:
+            self.speed_monitor.begin_resize(reason=f"preempt:{p.node_id}")
+            self.speed_monitor.reset_running_speed()
+        for manager in self.rdzv_managers.values():
+            manager.remove_alive_node(p.node_id)
+        if self.task_manager:
+            self.task_manager.recover_tasks(p.node_id)
+        if self.node_manager:
+            self.node_manager.report_event(p.node_id, "preempting", p.reason)
+        if self.auto_scaler is not None:
+            self.auto_scaler.note_preemption(p.node_id)
+        if self.timeline is not None:
+            # Recorded AFTER the retire: retiring evicts the node's
+            # observability series, and the notice must outlive its node
+            # (it is the resize's own record, not a host sample).
+            self.timeline.record(
+                p.node_id, "preempt_notice",
+                attrs={"grace_s": p.grace_s, "reason": p.reason,
+                       "src": "master"},
+            )
 
     def _report_event(self, env: msg.Envelope):
         p: msg.NodeEventReport = env.payload

@@ -45,6 +45,14 @@ class SpeedMonitor:
         self._fault_events = 0
         self._fault_lost_s = 0.0
         self._faults_by_seam: Dict[str, int] = {}
+        # Resize ledger: wall time between a resize notice (preemption
+        # drain, scale plan) and the re-formed world's first step advance.
+        # The paper's promise is that this stays seconds — the
+        # ``dlrover_resize_seconds_total`` gauge makes it measurable.
+        self._resizes = 0
+        self._resize_s_total = 0.0
+        self._resize_started: Optional[float] = None
+        self._resizes_by_reason: Dict[str, int] = {}
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -53,6 +61,11 @@ class SpeedMonitor:
         with self._lock:
             if step <= self._global_step:
                 return
+            if self._resize_started is not None:
+                # First step advance after a resize notice closes the
+                # window: everything in between was resize downtime.
+                self._resize_s_total += max(0.0, ts - self._resize_started)
+                self._resize_started = None
             if self._last_step_time is not None:
                 # Time between consecutive step reports counts as productive
                 # as long as steps keep advancing.
@@ -110,6 +123,32 @@ class SpeedMonitor:
                 "fault_events": self._fault_events,
                 "fault_lost_s": self._fault_lost_s,
                 "by_seam": dict(self._faults_by_seam),
+            }
+
+    def begin_resize(self, reason: str = ""):
+        """A resize (preemption drain / scale event) started.  The window
+        stays open until the next step advance; overlapping notices (every
+        preempted host reports) fold into one window."""
+        with self._lock:
+            if self._resize_started is None:
+                self._resize_started = time.time()
+            self._resizes += 1
+            if reason:
+                self._resizes_by_reason[reason] = (
+                    self._resizes_by_reason.get(reason, 0) + 1
+                )
+
+    def resize_ledger(self) -> Dict[str, object]:
+        with self._lock:
+            open_s = (
+                time.time() - self._resize_started
+                if self._resize_started is not None else 0.0
+            )
+            return {
+                "resizes": self._resizes,
+                "resize_s_total": self._resize_s_total,
+                "resize_open_s": open_s,
+                "by_reason": dict(self._resizes_by_reason),
             }
 
     def compile_ledger(self) -> Dict[str, float]:

@@ -240,6 +240,13 @@ class JobTimeline:
             gauge("dlrover_injected_fault_seconds_total",
                   fault_ledger["fault_lost_s"],
                   "wall seconds lost to injected delay faults")
+            resize = speed_monitor.resize_ledger()
+            gauge("dlrover_resizes_total", resize["resizes"],
+                  "elastic resize events (preemption drains / scale plans)")
+            gauge("dlrover_resize_seconds_total",
+                  resize["resize_s_total"] + resize["resize_open_s"],
+                  "wall seconds between a resize notice and the next "
+                  "step advance (open window included)")
             anomalies = speed_monitor.recent_anomalies()
             kinds: Counter = Counter(
                 encoded.split("@", 1)[0] for _, _, encoded in anomalies

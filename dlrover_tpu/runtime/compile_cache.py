@@ -29,6 +29,15 @@ from dlrover_tpu.common.log import default_logger as logger
 # restarted worker lands on the same cache).
 ENV_COMPILE_CACHE = "DLROVER_TPU_COMPILE_CACHE"
 
+# Opt-in override for the CPU-backend gate in ``maybe_enable``: on the CPU
+# backend, a process that *hits* cache entries another process wrote gets a
+# corrupt deserialized executable — SIGSEGV/SIGABRT inside the runtime, or
+# worse, silently garbage losses (observed: 3.2e30 then NaN grads).  Elastic
+# restarts are exactly that cross-process replay, so auto-enabling the cache
+# on CPU turns every resume into a crash loop.  Set to "1" only for
+# single-run cache-plumbing tests.
+ENV_COMPILE_CACHE_CPU_OK = "DLROVER_TPU_COMPILE_CACHE_CPU_OK"
+
 _enabled_dir: Optional[str] = None
 
 
@@ -63,11 +72,25 @@ def enabled_dir() -> Optional[str]:
     return _enabled_dir
 
 
+def _cpu_backend() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 - no backend => nothing to protect
+        return False
+
+
 def maybe_enable(explicit_dir: str = "", workdir: str = "") -> Optional[str]:
     """Resolve + enable the cache dir: explicit > env knob > workdir-derived.
 
     Returns the enabled directory, or None when no source names one (the
-    cache stays off — tests and ad-hoc runs must not write to CWD).
+    cache stays off — tests and ad-hoc runs must not write to CWD), or when
+    the backend is CPU: XLA's persisted CPU executables do not survive
+    cross-process reuse (deserialization yields crashing or silently wrong
+    programs), and an elastic restart is precisely a second process reading
+    the first one's entries.  ``ENV_COMPILE_CACHE_CPU_OK=1`` overrides for
+    single-process cache-plumbing tests; ``enable()`` itself stays ungated.
     """
     cache_dir = (
         explicit_dir
@@ -75,6 +98,16 @@ def maybe_enable(explicit_dir: str = "", workdir: str = "") -> Optional[str]:
         or (cache_dir_for(workdir) if workdir else "")
     )
     if not cache_dir:
+        return None
+    if (
+        os.environ.get(ENV_COMPILE_CACHE_CPU_OK, "") != "1"
+        and _cpu_backend()
+    ):
+        logger.warning(
+            "persistent compile cache disabled on the CPU backend "
+            "(cross-process executable reuse is unsound there; set %s=1 "
+            "to force)", ENV_COMPILE_CACHE_CPU_OK,
+        )
         return None
     return enable(cache_dir)
 

@@ -78,6 +78,19 @@ def initialize(force: bool = False):
     n = num_processes()
     if n <= 1 and not force:
         return
+    if os.environ.get("DLROVER_TPU_SKIP_JAX_INIT", "") == "1":
+        # Control-plane-only multi-host mode: each trainer keeps its own
+        # single-process jax world while rendezvous/sharding/checkpoint
+        # stay multi-host.  CPU backends cannot run multi-process XLA
+        # computations, so drills and benches on dev boxes use this to
+        # exercise the elastic control plane (the checkpoint world is
+        # still the sealed rendezvous world — the agent's saver stamps
+        # it — so cross-world restore paths stay real).
+        logger.warning(
+            "DLROVER_TPU_SKIP_JAX_INIT=1: not joining the %d-process jax "
+            "world; control-plane-only multi-host mode", n,
+        )
+        return
     import jax
 
     jax.distributed.initialize(

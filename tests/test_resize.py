@@ -1,0 +1,425 @@
+"""Resize as a non-event: cross-world checkpoint reshard + graceful drain.
+
+Four tiers, mirroring the PR's layers:
+
+1. cross-world restore — a step committed by *n* hosts reshards into any
+   target world *m* (params + optimizer state + RNG streams), the mixed-dir
+   authority walk prefers the freshest world, corruption degrades to the
+   last verified step, and genuinely partial step dirs are still rejected;
+2. the preemption watch — the ``preempt.notice`` seam is the scripted
+   warning (deterministic per plan+seed), the env-file path works, and the
+   latch fires the callback exactly once;
+3. the master drain — one PreemptionNotice RPC evicts the victim from
+   rendezvous, shrinks the scale target around the survivors, opens the
+   resize ledger window, and lands on the timeline/metrics surfaces;
+4. the trainer chaos run — a run preempted mid-stream resumes on a "new
+   host" (no shm, storage-only restore) from the last persisted checkpoint
+   with a loss trajectory equal to the never-interrupted run (SGD parity).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+from dlrover_tpu.common import faults
+from dlrover_tpu.common.storage import CheckpointDirLayout, PosixDiskStorage
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Unique shm/job tag + socket dir per test; no fault plan leaks."""
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"rz{os.getpid()}_{tmp_path.name}")
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- tier 1: cross-world restore ----------------------------------------------
+
+
+def _state(scale=1.0):
+    """Params + optimizer state + an RNG stream — the full restore surface."""
+    return {
+        "params": {
+            "w": jnp.arange(24, dtype=jnp.float32).reshape(6, 4) * scale,
+            "b": jnp.full((4,), 0.5 * scale, dtype=jnp.float32),
+        },
+        "opt_state": {
+            "mu": jnp.full((6, 4), 0.25 * scale, dtype=jnp.float32),
+            "nu": jnp.full((6, 4), 0.125 * scale, dtype=jnp.float32),
+        },
+        "rng": jax.random.PRNGKey(42),
+    }
+
+
+def _save_world(ckpt_dir, n, step, state):
+    """Persist one committed step the way a live world of n hosts does."""
+    savers, engines = [], []
+    for h in range(n):
+        saver = AsyncCheckpointSaver(ckpt_dir, host_index=h, num_hosts=n)
+        saver.set_world(list(range(n)))
+        saver.start()
+        savers.append(saver)
+        engines.append(CheckpointEngine(
+            ckpt_dir, host_index=h, num_hosts=n, agree_step_fn=lambda c: c,
+        ))
+    try:
+        for engine in engines:
+            assert engine.save_to_storage(step, state)
+        assert engines[0].wait_saver(timeout=30)  # lowest host commits
+    finally:
+        for engine in engines:
+            engine._shm.close(unlink=True)
+        for saver in savers:
+            saver.stop()
+
+
+def _restore(ckpt_dir, m, template):
+    """Fresh-process restore into a world of m hosts (shm gone)."""
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=m, agree_step_fn=lambda c: c,
+    )
+    try:
+        return engine.load(treedef=jax.tree_util.tree_structure(template))
+    finally:
+        engine._shm.close(unlink=True)
+
+
+def _assert_tree_equal(got, want):
+    got_leaves = jax.tree_util.tree_leaves(got)
+    want_leaves = jax.tree_util.tree_leaves(want)
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_cross_world_restore_matrix(tmp_path, n):
+    """A step saved by n hosts restores into every target world m with
+    params, optimizer state, and RNG streams equal — no world is special."""
+    ckpt = str(tmp_path / "ckpt")
+    state = _state()
+    _save_world(ckpt, n, step=7, state=state)
+    for m in (1, 2, 4):
+        step, loaded = _restore(ckpt, m, state)
+        assert step == 7, f"restore {n} -> {m} hosts lost the step"
+        _assert_tree_equal(loaded, state)
+
+
+def test_mixed_world_dir_prefers_freshest_world(tmp_path):
+    """After a 4->2 resize the survivors re-persist the same step: both
+    complete groups coexist in the dir, and restore must pick the world
+    whose commit stamp is freshest (the 2-host one), not error out."""
+    ckpt = str(tmp_path / "ckpt")
+    old = _state(scale=1.0)
+    new = _state(scale=2.0)
+    _save_world(ckpt, 4, step=9, state=old)
+    _save_world(ckpt, 2, step=9, state=new)
+    step, loaded = _restore(ckpt, 2, old)
+    assert step == 9
+    _assert_tree_equal(loaded, new)
+
+
+def test_corrupt_shard_degrades_across_worlds(tmp_path):
+    """A bit-flipped shard in the newest (4-host) step fails verification;
+    restore walks back to the older 2-host step and reshards that."""
+    ckpt = str(tmp_path / "ckpt")
+    good = _state(scale=1.0)
+    _save_world(ckpt, 2, step=10, state=good)
+    _save_world(ckpt, 4, step=20, state=_state(scale=3.0))
+    layout = CheckpointDirLayout(ckpt)
+    path = layout.data_path(20, 1, 4)
+    with open(path, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
+    step, loaded = _restore(ckpt, 1, good)
+    assert step == 10
+    _assert_tree_equal(loaded, good)
+
+
+def test_partial_step_dir_still_rejected(tmp_path):
+    """3-of-4 host files is not a world: the genuinely-partial step is
+    skipped (not half-restored) and the older committed step wins."""
+    ckpt = str(tmp_path / "ckpt")
+    state = _state()
+    _save_world(ckpt, 2, step=4, state=state)
+    _save_world(ckpt, 4, step=8, state=_state(scale=2.0))
+    layout = CheckpointDirLayout(ckpt)
+    for path in (
+        layout.meta_path(8, 3, 4),
+        layout.data_path(8, 3, 4),
+        layout.digest_path(8, 3, 4),
+    ):
+        os.remove(path)
+    step, loaded = _restore(ckpt, 2, state)
+    assert step == 4
+    _assert_tree_equal(loaded, state)
+
+
+def test_world_booking_lands_in_meta(tmp_path):
+    """The saver stamps world_size/world_hosts into the persisted meta
+    (legacy pickles restore without the fields; readers use getattr)."""
+    import pickle
+
+    ckpt = str(tmp_path / "ckpt")
+    _save_world(ckpt, 2, step=3, state=_state())
+    layout = CheckpointDirLayout(ckpt)
+    storage = PosixDiskStorage()
+    meta = pickle.loads(storage.read(layout.meta_path(3, 1, 2)))
+    assert getattr(meta, "world_size", 0) == 2
+    assert tuple(getattr(meta, "world_hosts", ())) == (0, 1)
+
+
+# -- tier 2: the preemption watch ---------------------------------------------
+
+
+def test_preempt_notice_seam_is_deterministic():
+    """Same plan + seed => same probe count, same reason, same fired log —
+    the property the resize drill's reproducibility rests on."""
+    from dlrover_tpu.agent.monitor import ResourceMonitor
+
+    def drill():
+        faults.configure("preempt.notice:error@3", seed=11)
+        reasons = []
+        monitor = ResourceMonitor(
+            client=None, on_preemption=reasons.append
+        )
+        probes = 1
+        while not monitor.check_preemption():
+            probes += 1
+            assert probes < 10, "scripted notice never fired"
+        return probes, reasons, list(faults.active().fired)
+
+    first = drill()
+    second = drill()
+    assert first == second
+    probes, reasons, fired = first
+    assert probes == 3
+    assert reasons == ["faultline:preempt.notice@3"]
+    assert fired == [("preempt.notice", "error", 3)]
+
+
+def test_preempt_file_detection_latches_once(tmp_path, monkeypatch):
+    from dlrover_tpu.agent.monitor import ResourceMonitor
+
+    notice = tmp_path / "preempt"
+    monkeypatch.setenv("DLROVER_TPU_PREEMPT_FILE", str(notice))
+    reasons = []
+    monitor = ResourceMonitor(client=None, on_preemption=reasons.append)
+    assert not monitor.check_preemption()
+    notice.write_text("maintenance-event")
+    assert monitor.check_preemption()
+    assert monitor.check_preemption()  # latched: no second callback
+    assert reasons == ["maintenance-event"]
+
+
+def test_rdzv_join_seam_retries_within_deadline():
+    """A transient rdzv.join fault is retried inside the rendezvous
+    deadline instead of failing the agent outright."""
+    from dlrover_tpu.agent.training_agent import (
+        ElasticLaunchConfig,
+        MasterRendezvousHandler,
+    )
+
+    class FakeClient:
+        _addr = "localhost:0"  # _agree_coordinator derives the routable ip
+
+        def __init__(self):
+            self.joins = 0
+            self.polls = 0
+
+        def join_rendezvous(self, rank, local_world, name, unit):
+            self.joins += 1
+            return 0
+
+        def get_comm_world(self, rank, name):
+            self.polls += 1
+
+            class State:
+                round = 1
+                world = {0: 1}
+
+            return State()
+
+        def kv_put(self, key, value):
+            pass
+
+    faults.configure("rdzv.join:error@1,2")
+    client = FakeClient()
+    handler = MasterRendezvousHandler(
+        client, 0, ElasticLaunchConfig(rdzv_timeout=10.0)
+    )
+    rdzv = handler.next_rendezvous()
+    assert client.joins == 1  # two injected failures, then the real join
+    assert rdzv["world"] == {0: 1}
+    assert [f[0] for f in faults.active().fired] == ["rdzv.join"] * 2
+
+
+# -- tier 3: the master drain -------------------------------------------------
+
+
+def test_preemption_notice_drains_master():
+    """One PreemptionNotice RPC: rendezvous eviction, shard requeue,
+    shrink ScalePlan around the survivors, resize-ledger window, and the
+    timeline/metrics surfaces — the whole master-side drain."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(port=0, num_nodes=2, min_nodes=1)
+    port = master.start()
+    c0 = c1 = None
+    try:
+        c0 = MasterClient(f"localhost:{port}", node_id=0)
+        c1 = MasterClient(f"localhost:{port}", node_id=1)
+        c0.join_rendezvous(0, 4)
+        c1.join_rendezvous(1, 4)
+        state = c0.get_comm_world(0)
+        assert state.world == {0: 4, 1: 4}
+        c0.report_event("started")
+        c1.report_event("started")
+
+        c1.report_preemption(grace_s=7.5, reason="maintenance")
+
+        # The survivor sees a changed world (victim evicted from rdzv).
+        assert c0.world_changed(state.round)
+        # The scaler followed the survivors instead of repairing to 2.
+        assert master.auto_scaler.target == 1
+        plan = master.auto_scaler.plans[-1]
+        assert plan.delete == [1] and plan.target_nodes == 1
+        # The resize ledger opened a window, attributed to the victim...
+        ledger = master.speed_monitor.resize_ledger()
+        assert ledger["resizes"] == 1
+        assert ledger["by_reason"] == {"preempt:1": 1}
+        # ...which the next step advance closes.
+        master.speed_monitor.collect_global_step(3, tokens=1)
+        ledger = master.speed_monitor.resize_ledger()
+        assert ledger["resize_open_s"] == 0.0
+        # Timeline records the notice; metrics expose the gauges.
+        events = master.timeline.events(1).get(1, [])
+        assert any(e[0] == "preempt_notice" for e in events)
+        text = master.timeline.render_metrics(
+            speed_monitor=master.speed_monitor
+        )
+        assert "dlrover_resizes_total 1" in text
+        assert "dlrover_resize_seconds_total" in text
+    finally:
+        for client in (c0, c1):
+            if client is not None:
+                client.close()
+        master.stop()
+
+
+def test_agent_drain_reports_and_stops():
+    """The agent-side drain: flush (no saver here), preemption notice to
+    the master, telemetry drain span shipped, workers stopped, STOPPED."""
+    from dlrover_tpu.agent.training_agent import (
+        ElasticAgent,
+        ElasticLaunchConfig,
+        RunResult,
+    )
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(port=0, num_nodes=2, min_nodes=1)
+    port = master.start()
+    agent = None
+    try:
+        agent = ElasticAgent(
+            ElasticLaunchConfig(
+                min_nodes=1, max_nodes=2, preempt_grace_s=5.0
+            ),
+            ["true"], f"localhost:{port}", node_id=1,
+        )
+        agent.request_preemption_drain("faultline:preempt.notice@3")
+        assert agent._drain_and_exit() == RunResult.STOPPED
+        assert agent._stop.is_set()
+        assert master.speed_monitor.resize_ledger()["resizes"] == 1
+        spans = master.timeline.spans(1, "drain")
+        assert spans and spans[0][4]["reason"] == (
+            "faultline:preempt.notice@3"
+        )
+        assert 0.0 < spans[0][4]["grace_s"] <= 5.0
+    finally:
+        if agent is not None:
+            agent.client.close()
+        master.stop()
+
+
+# -- tier 4: trainer chaos run ------------------------------------------------
+
+
+def test_preempt_resume_loss_trajectory_invariance(tmp_path, monkeypatch):
+    """Preempt a run mid-stream; a 'new host' (fresh shm namespace, so the
+    restore is forced through storage) resumes from the last persisted
+    checkpoint with zero step regression and the same loss trajectory as
+    the never-interrupted run.  SGD: linear in the gradient, so parity is
+    tight (memory note: AdamW amplifies fp32 reassociation)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    from dlrover_tpu.models.gpt2 import gpt2_config
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    model = gpt2_config(
+        "124m", num_layers=1, d_model=64, num_heads=2,
+        vocab_size=256, max_seq_len=32,
+    )
+
+    def batches(n, seed=0):
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            t = rng.integers(0, 256, size=(8, 33), dtype=np.int32)
+            out.append({"inputs": t[:, :-1], "targets": t[:, 1:]})
+        return out
+
+    data = batches(8)
+    common = dict(
+        global_batch_size=8, seq_len=32, optimizer="sgd",
+        learning_rate=1e-2, ckpt_every=2,
+    )
+    job = os.environ["DLROVER_TPU_JOB"]
+
+    def run(tag, ckpt_dir, batch_slice, max_steps):
+        monkeypatch.setenv("DLROVER_TPU_JOB", f"{job}_{tag}")
+        losses = {}
+        trainer = ElasticTrainer(
+            model,
+            TrainerConfig(**common, checkpoint_dir=ckpt_dir),
+            client=None,
+        )
+        start = trainer.step
+        trainer.fit(
+            iter(batch_slice), max_steps=max_steps,
+            on_step=lambda s, m: losses.__setitem__(s, float(m["loss"])),
+        )
+        trainer.close()
+        return start, losses
+
+    _, base_losses = run("base", str(tmp_path / "base"), data, 8)
+
+    chaos_ckpt = str(tmp_path / "chaos")
+    _, first_losses = run("chaos", chaos_ckpt, data[:4], 4)
+    # ... the host is preempted here; ckpt_every=2 persisted step 4 ...
+    start, resumed_losses = run("resume", chaos_ckpt, data[4:], 8)
+
+    # Zero steps lost beyond the last persisted checkpoint.
+    assert start == 4
+    assert sorted(first_losses) == [1, 2, 3, 4]
+    assert sorted(resumed_losses) == [5, 6, 7, 8]
+    for step in (1, 2, 3, 4):
+        np.testing.assert_allclose(
+            first_losses[step], base_losses[step], rtol=1e-5,
+        )
+    for step in (5, 6, 7, 8):
+        np.testing.assert_allclose(
+            resumed_losses[step], base_losses[step], rtol=1e-5,
+        )

@@ -147,9 +147,11 @@ def test_stale_done_files_cannot_satisfy_commit_barrier(tmp_path):
     saver.stop()
 
 
-def test_restore_rejects_ambiguous_mixed_world_step(tmp_path):
-    """Two self-consistent world-size groups in one step dir are ambiguous:
-    the step must be rejected (deterministically, not listdir-order luck)."""
+def test_restore_disambiguates_mixed_world_step(tmp_path):
+    """Two self-consistent world-size groups in one step dir are no longer
+    ambiguous: the done-marker commit barrier ranks the groups, so the
+    committed world restores and a forged, uncommitted group is ignored
+    (deterministically, not listdir-order luck)."""
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
     from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
 
@@ -173,7 +175,10 @@ def test_restore_rejects_ambiguous_mixed_world_step(tmp_path):
     step, loaded = engine.load_from_storage(
         treedef=jax.tree_util.tree_structure(old)
     )
-    assert step == -1 and loaded is None
+    # The real world-1 group carries the only done marker (score 1/1 vs
+    # 0/2), so it is the authority; the forged group never gets a vote.
+    assert step == 9
+    assert jnp.array_equal(loaded["w"], old["w"])
     engine.close()
     saver.stop()
 

@@ -370,6 +370,12 @@ def test_persistent_compile_cache_configured(tmp_path, monkeypatch):
     assert compile_cache.enable(cache_dir) == enabled  # idempotent
     # Resolution order: explicit > env > workdir-derived.
     assert compile_cache.cache_dir_for("/w") == "/w/compile_cache"
+    # On the CPU backend maybe_enable declines (cross-process reuse of
+    # persisted CPU executables crashes a resumed trainer); the dedicated
+    # opt-in env lets single-process plumbing tests through.
+    monkeypatch.delenv(compile_cache.ENV_COMPILE_CACHE_CPU_OK, raising=False)
+    assert compile_cache.maybe_enable("", workdir=str(tmp_path)) is None
+    monkeypatch.setenv(compile_cache.ENV_COMPILE_CACHE_CPU_OK, "1")
     via_workdir = compile_cache.maybe_enable("", workdir=str(tmp_path))
     assert via_workdir == os.path.join(str(tmp_path), "compile_cache")
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -159,9 +160,30 @@ CPU_FALLBACK_STEPS = 3
 _CPU_SCRUBBED = False
 
 
+def _ensure_cpu(cause: str) -> None:
+    """Pin this process to the CPU backend after a failed probe.
+
+    The relay triggers are exactly what wedged the probe — scrub them
+    before this process initializes its own (CPU) backend.  Idempotent;
+    shared by the fallback bench and the scaling sweep so whichever runs
+    first pays the scrub.
+    """
+    global _CPU_SCRUBBED
+    if _CPU_SCRUBBED:
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from dlrover_tpu.runtime import env as renv
+
+    renv.scrub_device_relay_triggers(os.environ)
+    jax.config.update("jax_platforms", "cpu")
+    _CPU_SCRUBBED = True
+
+
 def _cpu_fallback_bench(cause: str, entry: str = "baseline",
                         grad_accum: int = 1,
-                        reduce_quant: str = "none") -> None:
+                        reduce_quant: str = "none",
+                        zero1: bool = False,
+                        scaling: "dict | None" = None) -> None:
     """Relative CPU-mesh metric when the TPU backend is wedged.
 
     A ``value: 0 / backend-unavailable`` artifact tells the trajectory
@@ -172,18 +194,7 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
     ``cause`` is decided once by the caller and reused verbatim for every
     entry — the fallback itself never re-probes.
     """
-    import os
-
-    global _CPU_SCRUBBED
-    if not _CPU_SCRUBBED:
-        # The relay triggers are exactly what wedged the probe — scrub them
-        # before this process initializes its own (CPU) backend.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        from dlrover_tpu.runtime import env as renv
-
-        renv.scrub_device_relay_triggers(os.environ)
-        jax.config.update("jax_platforms", "cpu")
-        _CPU_SCRUBBED = True
+    _ensure_cpu(cause)
 
     from dlrover_tpu.models.transformer import (
         TransformerConfig, TransformerLM,
@@ -207,7 +218,7 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
     train = train_lib.build_sharded_train(
         model, opt, mesh, lr.DEFAULT_RULES,
         global_batch_size=global_batch, seq_len=CPU_FALLBACK_SEQ,
-        grad_accum=grad_accum, reduce_quant=reduce_quant,
+        grad_accum=grad_accum, reduce_quant=reduce_quant, zero1=zero1,
     )
     state = train.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
@@ -247,14 +258,18 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
     if entry != "baseline":
         detail["grad_accum"] = grad_accum
         detail["reduce_quant"] = reduce_quant
-    print(json.dumps({
+        detail["zero1"] = bool(train.zero1)
+    out = {
         "metric": _entry_metric(entry),
         "value": round(global_batch * CPU_FALLBACK_SEQ / step_time, 2),
         "unit": "tokens/s (cpu fallback shape)",
         "vs_baseline": 0,
         "mode": "cpu-fallback",
         "detail": detail,
-    }))
+    }
+    if scaling is not None:
+        out["scaling"] = scaling
+    print(json.dumps(out))
 
 
 def _entry_metric(entry: str) -> str:
@@ -266,14 +281,19 @@ def _entry_metric(entry: str) -> str:
 # The sweep: each entry is one knob variation on the headline config.
 # grad_accum=4 exercises the microbatch engine (scan overhead + deferred
 # reduce) at identical global batch — the value SHOULD track baseline;
-# the gap is the engine's real cost on this backend.
+# the gap is the engine's real cost on this backend.  zero1 exercises the
+# cross-replica sharded weight update (dp > 1: reduce-scatter + sharded
+# update + all-gather; on a single chip it degrades to the baseline step).
 BENCH_ENTRIES = (
     ("baseline", {"grad_accum": 1, "reduce_quant": "none"}),
     ("grad_accum=4", {"grad_accum": 4, "reduce_quant": "none"}),
+    ("zero1", {"grad_accum": 4, "reduce_quant": "none", "zero1": True}),
 )
 
 
-def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str) -> None:
+def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
+               zero1: bool = False,
+               scaling: "dict | None" = None) -> None:
     from dlrover_tpu.auto import est_comm_time, pick_grad_accum
     from dlrover_tpu.models.gpt2 import gpt2_config
     from dlrover_tpu.models.transformer import TransformerLM
@@ -301,7 +321,7 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str) -> None:
         model, opt, mesh, lr.DEFAULT_RULES,
         global_batch_size=global_batch, seq_len=SEQ_LEN,
         ce_chunks=CE_CHUNKS,
-        grad_accum=grad_accum, reduce_quant=reduce_quant,
+        grad_accum=grad_accum, reduce_quant=reduce_quant, zero1=zero1,
     )
     state = train.init(jax.random.PRNGKey(0))
 
@@ -367,7 +387,7 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str) -> None:
             "reduce_quant": reduce_quant,
             "auto_pick_grad_accum": pick_grad_accum(
                 config, parallel, global_batch, SEQ_LEN,
-                remat=REMAT, optimizer="adafactor",
+                remat=REMAT, optimizer="adafactor", zero1=zero1,
             ),
             "est_reduce_s_full": round(
                 est_comm_time(config, parallel, "none"), 6
@@ -376,14 +396,26 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str) -> None:
                 est_comm_time(config, parallel, "int8"), 6
             ),
         })
-    print(json.dumps({
+    if zero1:
+        detail["zero1"] = bool(train.zero1)
+        if train.zero1_stats:
+            # The sharded-update memory story (opt-state MB/device before
+            # vs after the data-axis split) — PROFILE.md's memory model.
+            detail["zero1_stats"] = {
+                k: round(v, 1) if isinstance(v, float) else v
+                for k, v in train.zero1_stats.items()
+            }
+    out = {
         "metric": _entry_metric(entry),
         "value": round(tokens_per_sec_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(hfu / REFERENCE_HFU, 4),
         "mode": "tpu",
         "detail": detail,
-    }))
+    }
+    if scaling is not None:
+        out["scaling"] = scaling
+    print(json.dumps(out))
 
 
 def main(argv=None) -> int:
@@ -393,6 +425,12 @@ def main(argv=None) -> int:
         help="run only the first N sweep entries (0 = all); the backend "
              "probe still runs exactly once regardless",
     )
+    args.add_argument(
+        "--no-scaling", action="store_true",
+        help="skip the 1->8 scaling-curve measurement (also "
+             "DLROVER_TPU_BENCH_SCALING=0); entries then carry no "
+             "'scaling' block",
+    )
     opts = args.parse_args(argv)
     entries = BENCH_ENTRIES
     if opts.max_entries > 0:
@@ -401,6 +439,22 @@ def main(argv=None) -> int:
     # PROBE_ATTEMPTS x PROBE_TIMEOUT_S once, and every entry reuses the
     # verdict (VERDICT top_next: no second 180 s hang).
     cause = _probe_backend()
+    # The 1->n scaling curve is measured ONCE and attached to every
+    # entry's JSON (the curve is a property of the sweep's backend, not of
+    # any single knob).  measure_scaling does its own virtual-CPU
+    # subprocess when this backend is too small for n=8.
+    scaling = None
+    if not opts.no_scaling and (
+        os.environ.get("DLROVER_TPU_BENCH_SCALING", "1") != "0"
+    ):
+        try:
+            if cause is not None:
+                _ensure_cpu(cause)
+            from dlrover_tpu.utils.scaling import measure_scaling
+
+            scaling = measure_scaling((1, 2, 4, 8))
+        except Exception as e:  # noqa: BLE001 — curve is additive, not load-bearing
+            scaling = {"ok": False, "cause": f"{type(e).__name__}: {e}"}
     rc = 0
     for entry, knobs in entries:
         try:
@@ -409,9 +463,11 @@ def main(argv=None) -> int:
                 # weak #8) — and still a live measurement: the CPU-mesh
                 # fallback keeps the trajectory comparable instead of
                 # flatlining at 0.
-                _cpu_fallback_bench(cause, entry=entry, **knobs)
+                _cpu_fallback_bench(
+                    cause, entry=entry, scaling=scaling, **knobs
+                )
             else:
-                _tpu_bench(entry, **knobs)
+                _tpu_bench(entry, scaling=scaling, **knobs)
         except Exception as e:  # noqa: BLE001 — one entry must not eat the sweep
             # Even the fallback can die (OOM, wedged child): the driver
             # still needs one parseable ok=false line per entry instead

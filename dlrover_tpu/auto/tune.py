@@ -483,6 +483,7 @@ def pick_grad_accum(
     optimizer: str = "adamw",
     accum_dtype: str = "float32",
     hbm_bytes: Optional[float] = None,
+    zero1: bool = False,
 ) -> int:
     """Smallest grad_accum N whose per-microbatch footprint fits HBM.
 
@@ -495,6 +496,12 @@ def pick_grad_accum(
     the per-dp-shard batch, walked smallest-first; when nothing fits the
     largest feasible N is returned (the best the knob can do — the caller
     sees the estimate and can shrink the model or batch).
+
+    ``zero1=True`` prices the ZeRO-1 sharded update: the optimizer-state
+    bytes divide by the extra ``data``-axis factor (each replica keeps
+    its 1/dp slice; params and grads stay as before — grads are consumed
+    by the reduce-scatter, params re-gather to full size), so a config
+    that is opt-state-bound can fit with a smaller N or none at all.
     """
     _, _, hbm_default, _ = chip_specs()
     hbm = hbm_bytes if hbm_bytes is not None else hbm_default
@@ -505,7 +512,9 @@ def pick_grad_accum(
     dp = max(p.data * p.fsdp, 1)
     opt_mult = {"adamw": 8.0, "adafactor": 0.2, "q8_adam": 2.2,
                 "q4_adam": 1.25, "sgd": 4.0, "lion": 4.0}.get(optimizer, 8.0)
-    fixed_b = n * (2 + 2 + opt_mult) / shard  # params + grads + optimizer
+    opt_shard = shard * (max(p.data, 1) if zero1 else 1)
+    # params + grads replicated over data; optimizer state 1/dp under zero1
+    fixed_b = n * (2 + 2) / shard + n * opt_mult / opt_shard
     accum_b = n * (2 if accum_dtype in ("bf16", "bfloat16") else 4) / shard
     tokens_local = (
         global_batch_size * seq_len / dp / max(p.seq, 1)
@@ -537,13 +546,16 @@ def est_comm_time(
 ) -> float:
     """Seconds for the once-per-step data-parallel gradient reduce.
 
-    Prices the microbatch engine's deferred reduce on both wire formats
-    with ``_estimate``'s constants: full-precision bf16 ring all-reduce
-    bytes ``2·n·2/shard·(dp-1)/dp`` over ICI; ``"int8"`` divides the wire
-    bytes by ~3.5 (int8 payload + fp32 block scales vs bf16, the
-    quantized_dcn folding) but pays ~3 extra HBM sweeps over the sharded
-    gradient tree for the quantize/dequantize passes.  Zero when data=1:
-    there is no reduce to price.
+    Modeled as its actual lowering — a reduce-scatter leg plus an
+    all-gather leg, each moving ``n·2/shard·(dp-1)/dp`` bytes over ICI
+    (the bandwidth-optimal ring; their sum equals the classic
+    ``2·(dp-1)/dp`` all-reduce volume, so the full-precision price is
+    unchanged).  The split matters for ``"int8"``: the quantized wire
+    format applies to the reduce-scatter leg only (int8 payload + fp32
+    block scales, ~3.5x fewer bytes than bf16) while the gather leg —
+    under ZeRO-1 the updated *params* riding back — stays full precision;
+    the quantize/dequantize passes add ~2 HBM sweeps over the sharded
+    gradient tree.  Zero when data=1: there is no reduce to price.
     """
     _, hbm_bw, _, ici_bw = chip_specs()
     p = parallel
@@ -551,10 +563,14 @@ def est_comm_time(
         return 0.0
     n = config.num_params()
     shard = p.fsdp * p.tensor * p.pipe * max(p.expert, 1)
-    wire_b = 2 * n * 2 / shard * (p.data - 1) / p.data
+    leg_b = n * 2 / shard * (p.data - 1) / p.data
     if reduce_quant == "int8":
-        return wire_b / 3.5 / ici_bw + 3 * (n * 2 / shard) / hbm_bw
-    return wire_b / ici_bw
+        return (
+            leg_b / 3.5 / ici_bw          # quantized reduce-scatter leg
+            + leg_b / ici_bw              # full-precision gather leg
+            + 2 * (n * 2 / shard) / hbm_bw  # quantize/dequantize sweeps
+        )
+    return 2 * leg_b / ici_bw
 
 
 def _measure(

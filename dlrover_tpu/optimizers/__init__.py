@@ -1,3 +1,7 @@
 from dlrover_tpu.optimizers.agd import agd  # noqa: F401
 from dlrover_tpu.optimizers.wsam import make_wsam_step  # noqa: F401
 from dlrover_tpu.optimizers.mup import mup_scale, mup_config  # noqa: F401
+from dlrover_tpu.optimizers.zero1 import (  # noqa: F401
+    shard_update_shardings,
+    zero1_partition_spec,
+)

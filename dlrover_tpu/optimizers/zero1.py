@@ -1,0 +1,145 @@
+"""ZeRO-1 for XLA: cross-replica sharding of the weight update.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv:2004.13336, PAPERS.md) observes that in pure data
+parallelism the optimizer state and the parameter update are computed
+identically on every replica — dp-way redundant HBM and dp-way redundant
+FLOPs.  The XLA-native fix needs no module surgery and no optimizer
+rewrite: extend each optimizer-state (and, transiently, gradient/param)
+leaf's ``PartitionSpec`` with the ``data`` mesh axis on one divisible
+dimension.  GSPMD then lowers the data-parallel gradient sum as a
+**reduce-scatter** feeding a shard-local ``tx.update``, and the
+re-replication of the updated params as an **all-gather** — the classic
+ZeRO-1 schedule, recovered entirely from sharding annotations.
+
+This module owns the spec derivation; ``trainer.train_lib`` applies it
+(persistently to ``opt_state`` via the train state's out-shardings,
+transiently to grads/params around the update inside the step program).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _entry_names(entry) -> Tuple[str, ...]:
+    """Normalize one PartitionSpec entry to a tuple of mesh-axis names."""
+    if entry is None:
+        return ()
+    if isinstance(entry, tuple):
+        return tuple(entry)
+    return (entry,)
+
+
+def _dim_shard(mesh_sizes: Dict[str, int], entry) -> int:
+    out = 1
+    for name in _entry_names(entry):
+        out *= mesh_sizes.get(name, 1)
+    return out
+
+
+def zero1_partition_spec(
+    shape: Tuple[int, ...],
+    spec: PartitionSpec,
+    mesh_sizes: Dict[str, int],
+    axis: str = "data",
+) -> Optional[PartitionSpec]:
+    """The update-sharded PartitionSpec for one leaf, or None.
+
+    Appends ``axis`` to the first dimension that stays whole-sized after
+    the split (``dim % (existing_shard * dp) == 0``).  Returns None when
+    the leaf cannot take the axis — scalars, leaves already sharded over
+    ``axis`` somewhere, or leaves with no divisible dimension — in which
+    case the caller keeps the replicated update for that leaf (correct,
+    just not deduplicated).
+    """
+    dp = mesh_sizes.get(axis, 1)
+    if dp <= 1 or not shape:
+        return None
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for entry in entries:
+        if axis in _entry_names(entry):
+            return None  # already laid out over the data axis
+    for i, dim in enumerate(shape):
+        cur = _dim_shard(mesh_sizes, entries[i])
+        if dim > 0 and dim % (cur * dp) == 0:
+            new_entry = (*_entry_names(entries[i]), axis)
+            new_entries = list(entries)
+            new_entries[i] = new_entry[0] if len(new_entry) == 1 \
+                else new_entry
+            return PartitionSpec(*new_entries)
+    return None
+
+
+def shard_update_shardings(
+    mesh: Mesh,
+    abstract_tree: Any,
+    sharding_tree: Any,
+    axis: str = "data",
+) -> Tuple[Any, Dict[str, Any]]:
+    """Map a (ShapeDtypeStruct, NamedSharding) tree to ZeRO-1 shardings.
+
+    Returns ``(new_sharding_tree, stats)``: every shardable leaf gets the
+    ``axis``-extended spec from :func:`zero1_partition_spec`; the rest keep
+    their original sharding.  ``stats`` reports how much of the update
+    actually sharded — per-device bytes before/after and leaf counts — the
+    numbers PROFILE.md's memory model and the bench detail print.
+    """
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = mesh_sizes.get(axis, 1)
+    stats = {
+        "axis": axis,
+        "dp": dp,
+        "sharded_leaves": 0,
+        "replicated_leaves": 0,
+        "bytes_per_device_before": 0,
+        "bytes_per_device_after": 0,
+    }
+
+    def one(aval, sharding):
+        if not isinstance(sharding, NamedSharding):
+            stats["replicated_leaves"] += 1
+            return sharding
+        nbytes = getattr(aval, "size", 0) * getattr(
+            aval.dtype, "itemsize", 4
+        )
+        before = nbytes / max(1, _dim_shard_total(mesh_sizes, sharding.spec))
+        zspec = zero1_partition_spec(
+            tuple(aval.shape), sharding.spec, mesh_sizes, axis
+        )
+        if zspec is None:
+            stats["replicated_leaves"] += 1
+            stats["bytes_per_device_before"] += before
+            stats["bytes_per_device_after"] += before
+            return sharding
+        stats["sharded_leaves"] += 1
+        stats["bytes_per_device_before"] += before
+        stats["bytes_per_device_after"] += before / dp
+        return NamedSharding(mesh, zspec)
+
+    new_tree = jax.tree.map(one, abstract_tree, sharding_tree)
+    return new_tree, stats
+
+
+def _dim_shard_total(mesh_sizes: Dict[str, int], spec) -> int:
+    out = 1
+    for entry in spec:
+        out *= _dim_shard(mesh_sizes, entry)
+    return out
+
+
+def data_axis_dim(spec: PartitionSpec, axis: str = "data") -> Optional[int]:
+    """Which dimension of ``spec`` carries ``axis`` (None when absent).
+
+    The int8 reduce-scatter routing needs this: the quantized collective
+    splits the gradient along exactly the dimension the ZeRO-1 spec put
+    the data axis on, so shard_map's out_specs line up with the member
+    chunks.
+    """
+    for i, entry in enumerate(spec):
+        if axis in _entry_names(entry):
+            return i
+    return None

@@ -29,6 +29,63 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+# Below this payload the n-1 quantized ring hops are pure latency: the
+# one-shot all-to-all (two logical hops) wins.  EQuARX's crossover on ICI
+# sits near the MiB scale; the exact constant only shifts which tiny
+# leaves take which lowering, both of which are correct.
+RING_MIN_BYTES = 1 << 20
+
+
+def _axis_size(axis_name: str) -> int:
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    # older jax: the mesh axis size is a trace-time constant
+    return (
+        jax.core.get_axis_env().axis_size(axis_name)
+        if hasattr(jax.core, "get_axis_env")
+        else int(jax.lax.psum(1, axis_name))
+    )
+
+
+def axis_crosses_dcn(mesh, axis_name: str) -> bool:
+    """Whether the mesh axis spans TPU slices (so its wire is DCN).
+
+    Slice membership comes from the devices' ``slice_index``; CPU and
+    single-slice devices have none, so they never cross.
+    """
+    try:
+        import numpy as np
+
+        ax = list(mesh.axis_names).index(axis_name)
+        along = np.moveaxis(mesh.devices, ax, 0)
+        slices = {
+            getattr(along[i].flat[0], "slice_index", 0)
+            for i in range(along.shape[0])
+        }
+        return len(slices) > 1
+    except Exception:  # noqa: BLE001 - unknown topology: assume one slice
+        return False
+
+
+def select_reduce_algo(
+    n: int, payload_bytes: int = 0, crosses_dcn: bool = False
+) -> str:
+    """EQuARX-style topology-aware algorithm choice: "oneshot" | "ring".
+
+    The one-shot (all-to-all, tree-like two logical hops, one quantization
+    round) wins when latency dominates — tiny groups, small payloads, or a
+    DCN-crossing axis where per-hop latency is ~100x ICI.  The ring
+    (``n-1`` neighbor hops, quantizing the travelling partial each hop) is
+    bandwidth-optimal per element and wins for large ICI payloads; its
+    price is one quantization round *per hop*, so its error grows with
+    ``n`` — another reason to keep small groups on one-shot.
+    """
+    if crosses_dcn or n <= 2:
+        return "oneshot"
+    if payload_bytes and payload_bytes < RING_MIN_BYTES:
+        return "oneshot"
+    return "ring"
+
 
 def _block_quant(x: jax.Array, block: int) -> Tuple[jax.Array, jax.Array]:
     """[N] fp -> (int8 [N], scales fp32 [N/block]); N padded by caller."""
@@ -44,21 +101,65 @@ def _block_dequant(q: jax.Array, scales: jax.Array, block: int) -> jax.Array:
     return (rows * scales[:, None]).reshape(-1)
 
 
+def _oneshot_rs(
+    chunks: jax.Array, axis_name: str, n: int, block: int
+) -> jax.Array:
+    """Tree/one-shot reduce-scatter core: quantize all n chunks, one
+    all-to-all so member i receives every replica's chunk i, dequantize +
+    fp32 sum.  ``chunks`` is fp32 [n, shard] with shard % block == 0;
+    returns this member's reduced fp32 [shard]."""
+    shard = chunks.shape[1]
+    q, scales = _block_quant(chunks.reshape(-1), block)
+    q_shards = q.reshape(n, shard)
+    s_shards = scales.reshape(n, shard // block)
+    q_recv = jax.lax.all_to_all(q_shards, axis_name, 0, 0, tiled=False)
+    s_recv = jax.lax.all_to_all(s_shards, axis_name, 0, 0, tiled=False)
+    contributions = jax.vmap(
+        lambda qq, ss: _block_dequant(qq, ss, block)
+    )(q_recv, s_recv)
+    return jnp.sum(contributions, axis=0)
+
+
+def _ring_rs(
+    chunks: jax.Array, axis_name: str, n: int, block: int
+) -> jax.Array:
+    """Ring reduce-scatter core: ``n-1`` neighbor hops, the travelling
+    partial re-quantized per hop (the EQuARX ring).  Bandwidth-optimal —
+    each member sends one chunk per hop instead of n-1 chunks at once.
+    Member i ends holding reduced chunk i (matching shard_map's member ->
+    block placement along the axis)."""
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    # At hop t member i sends the partial for chunk (i - t - 1) mod n and
+    # receives chunk (i - t - 2) mod n, adding its local copy; after n-1
+    # hops the accumulated partial is chunk i, fully reduced.
+    acc = jnp.take(chunks, (idx - 1) % n, axis=0)
+    for t in range(n - 1):
+        q, s = _block_quant(acc, block)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        s = jax.lax.ppermute(s, axis_name, perm)
+        received = _block_dequant(q, s, block)
+        acc = received + jnp.take(chunks, (idx - t - 2) % n, axis=0)
+    return acc
+
+
 def quantized_all_reduce(
-    x: jax.Array, axis_name: str, block: int = 256, mean: bool = True
+    x: jax.Array,
+    axis_name: str,
+    block: int = 256,
+    mean: bool = True,
+    algo: str = "oneshot",
 ) -> jax.Array:
     """All-reduce ``x`` over ``axis_name`` with an int8 wire format.
 
     Call inside ``shard_map``/``pmap`` where ``axis_name`` is bound.  The
     result is identical on every member (quantization error included), so
-    replicated-parameter invariants hold.
+    replicated-parameter invariants hold.  ``algo`` selects the
+    reduce-scatter phase's lowering ("oneshot" all-to-all vs "ring"
+    neighbor hops — see :func:`select_reduce_algo`); the broadcast phase
+    is an all-gather either way.
     """
-    if hasattr(jax.lax, "axis_size"):
-        n = jax.lax.axis_size(axis_name)
-    else:  # older jax: the mesh axis size is a trace-time constant
-        n = jax.core.get_axis_env().axis_size(axis_name) if hasattr(
-            jax.core, "get_axis_env"
-        ) else int(jax.lax.psum(1, axis_name))
+    n = _axis_size(axis_name)
     if n == 1:
         return x
     orig_shape, orig_dtype = x.shape, x.dtype
@@ -67,17 +168,10 @@ def quantized_all_reduce(
     shard = -(-flat.size // (n * block)) * block
     flat = jnp.pad(flat, (0, shard * n - flat.size))
 
-    # Phase 1: quantize my n shards, all-to-all so member i receives every
-    # replica's shard i, dequantize + fp32 sum.
-    q, scales = _block_quant(flat, block)
-    q_shards = q.reshape(n, shard)
-    s_shards = scales.reshape(n, shard // block)
-    q_recv = jax.lax.all_to_all(q_shards, axis_name, 0, 0, tiled=False)
-    s_recv = jax.lax.all_to_all(s_shards, axis_name, 0, 0, tiled=False)
-    contributions = jax.vmap(
-        lambda qq, ss: _block_dequant(qq, ss, block)
-    )(q_recv, s_recv)
-    reduced = jnp.sum(contributions, axis=0)
+    # Phase 1: quantized reduce-scatter -> my reduced fp32 shard.
+    chunks = flat.reshape(n, shard)
+    rs = _ring_rs if algo == "ring" else _oneshot_rs
+    reduced = rs(chunks, axis_name, n, block)
     if mean:
         reduced = reduced / n
 
@@ -89,6 +183,49 @@ def quantized_all_reduce(
         q_all, s_all
     ).reshape(-1)
     return out[: x.size].reshape(orig_shape).astype(orig_dtype)
+
+
+def quantized_reduce_scatter(
+    x: jax.Array,
+    axis_name: str,
+    *,
+    dim: int = 0,
+    block: int = 256,
+    mean: bool = True,
+    algo: str = "oneshot",
+) -> jax.Array:
+    """Reduce-scatter ``x`` over ``axis_name`` on the int8 wire format.
+
+    Member ``i`` returns chunk ``i`` of the reduction, split along ``dim``
+    (which must divide evenly by the axis size) — exactly the shard_map
+    out_specs contract when the caller adds ``axis_name`` to ``dim`` of
+    the out spec.  This is the ZeRO-1 gradient leg: the quantized wire
+    carries each gradient exactly once (vs twice for the all-reduce),
+    feeding the shard-local optimizer update; the updated params ride back
+    on a full-precision all-gather, so quantization noise never touches
+    the master weights.
+    """
+    n = _axis_size(axis_name)
+    if n == 1:
+        return x
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"reduce-scatter dim {dim} (size {x.shape[dim]}) must divide "
+            f"by the {n}-member axis {axis_name!r}"
+        )
+    orig_dtype = x.dtype
+    moved = jnp.moveaxis(x, dim, 0)
+    chunk_shape = (moved.shape[0] // n,) + moved.shape[1:]
+    chunks = moved.astype(jnp.float32).reshape(n, -1)
+    csize = chunks.shape[1]
+    padded = -(-csize // block) * block
+    chunks = jnp.pad(chunks, ((0, 0), (0, padded - csize)))
+    rs = _ring_rs if algo == "ring" else _oneshot_rs
+    reduced = rs(chunks, axis_name, n, block)
+    if mean:
+        reduced = reduced / n
+    out = reduced[:csize].reshape(chunk_shape)
+    return jnp.moveaxis(out, 0, dim).astype(orig_dtype)
 
 
 def quantized_process_allgather(local_tree, block: int = 256):

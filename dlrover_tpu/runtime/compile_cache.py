@@ -123,6 +123,7 @@ def train_cache_key(
     grad_accum: int = 1,
     accum_dtype: str = "float32",
     reduce_quant: str = "none",
+    zero1: bool = False,
 ) -> str:
     """Name the compiled train program by everything that shapes it.
 
@@ -131,8 +132,9 @@ def train_cache_key(
     objects — a restart's fresh Mesh over the same devices must hit), the
     batch geometry, the optimizer recipe, and the microbatch-engine knobs
     (grad_accum reshapes the whole step program; accum_dtype/reduce_quant
-    change the accumulator and reduce lowering — aliasing any of them
-    would hand a resized world the wrong executable).
+    change the accumulator and reduce lowering; zero1 reshards the whole
+    optimizer update — aliasing any of them would hand a resized world
+    the wrong executable).
     """
     fields = tuple(sorted(
         (k, repr(v)) for k, v in vars(model_config).items()
@@ -140,5 +142,5 @@ def train_cache_key(
     return repr((
         type(model_config).__name__, fields, tuple(mesh_shape),
         global_batch_size, seq_len, ce_chunks, optimizer,
-        grad_accum, accum_dtype, reduce_quant,
+        grad_accum, accum_dtype, reduce_quant, zero1,
     ))

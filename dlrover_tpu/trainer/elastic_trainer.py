@@ -93,6 +93,10 @@ class TrainerConfig:
     # "int8" routes the deferred once-per-step DP gradient reduce through
     # the EQuARX-style quantized all-reduce; "none" = XLA's fp reduce.
     reduce_quant: str = "none"
+    # ZeRO-1 cross-replica sharded weight update: optimizer state and the
+    # parameter update sharded over the data axis, DP reduce lowered as
+    # reduce-scatter + all-gather (optimizers/zero1.py).
+    zero1: bool = False
     # World size ``grad_accum`` was chosen for; 0 = the world at first
     # construction.  Booked in checkpoint `extra` so a restore into a
     # different world recomputes N from the ORIGINAL reference pairing.
@@ -297,6 +301,7 @@ class ElasticTrainer:
                 grad_accum=self.grad_accum,
                 accum_dtype=config.accum_dtype,
                 reduce_quant=config.reduce_quant,
+                zero1=config.zero1,
             )
         return train_lib.build_sharded_train(
             self.model, self.optimizer, self.mesh, self._rules,
@@ -306,6 +311,7 @@ class ElasticTrainer:
             grad_accum=self.grad_accum,
             accum_dtype=config.accum_dtype,
             reduce_quant=config.reduce_quant,
+            zero1=config.zero1,
             cache_key=cache_key,
         )
 
@@ -318,6 +324,7 @@ class ElasticTrainer:
             },
             "accum_dtype": self.config.accum_dtype,
             "reduce_quant": self.config.reduce_quant,
+            "zero1": self.config.zero1,
             "global_batch_size": self.config.global_batch_size,
             "world": self._world,
         }
@@ -368,14 +375,17 @@ class ElasticTrainer:
             pipeline_counters().record_dispatch(
                 self.step, time.perf_counter() - t0
             )
-        if self.train.grad_accum > 1 and telemetry.recorder().enabled:
+        if (
+            self.train.grad_accum > 1 or self.train.zero1
+        ) and telemetry.recorder().enabled:
             # The accumulate/reduce/update phases live inside one XLA
             # program, invisible to the host — emit the cost-model
             # breakdown as sub-spans backdated into the measured step span
             # (source="modeled") so the job timeline shows the overlap.
             wall = time.monotonic() - t_span
             for row in train_lib.microbatch_phase_plan(
-                self.train.grad_accum, self.train.reduce_quant, wall
+                self.train.grad_accum, self.train.reduce_quant, wall,
+                zero1=self.train.zero1,
             ):
                 telemetry.event(
                     row["phase"], duration_s=row["dur"],

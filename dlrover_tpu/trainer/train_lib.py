@@ -250,6 +250,13 @@ class ShardedTrain:
     grad_accum: int = 1
     accum_dtype: str = "float32"
     reduce_quant: str = "none"
+    # ZeRO-1 sharded weight update: True when the optimizer state and the
+    # parameter update are sharded over the data axis (optimizers/zero1.py
+    # spec derivation; inactive when the mesh has no data axis > 1).
+    zero1: bool = False
+    # Leaf counts + per-device bytes from the zero1 spec derivation —
+    # what bench/PROFILE report as the replicated-vs-sharded memory model.
+    zero1_stats: Optional[Dict[str, Any]] = None
     _aot_step: Optional[Callable] = None
 
     def init(self, rng: jax.Array) -> TrainState:
@@ -368,6 +375,7 @@ def build_sharded_train(
     grad_accum: int = 1,
     accum_dtype: str = "float32",
     reduce_quant: str = "none",
+    zero1: bool = False,
     cache_key: Optional[str] = None,
 ) -> ShardedTrain:
     """Construct init/step functions jitted with mesh shardings.
@@ -397,6 +405,21 @@ def build_sharded_train(
     collective over data-replicated values — exercising the int8 wire path
     (and its quantization rounding) inside the compiled program; with
     ``data=1`` it is the identity.
+
+    ``zero1=True`` turns on the cross-replica sharded weight update
+    (ZeRO-1-for-XLA, arXiv:2004.13336): optimizer state is laid out with
+    the ``data`` axis folded into each leaf's sharding
+    (``optimizers.zero1``), and the step replaces ``apply_gradients`` with
+    pin-grads-to-shard -> shard-local ``tx.update`` -> all-gather of the
+    updated params.  GSPMD lowers the pin as a reduce-scatter (half the
+    all-reduce wire) and the re-replication as an all-gather, and each
+    replica pays 1/dp of the optimizer-state HBM and update FLOPs.  The
+    update math is unchanged — parity with the replicated step holds to
+    float-reassociation tolerance — so the knob composes freely with
+    ``grad_accum`` and ``reduce_quant`` (whose int8 wire then runs as a
+    per-shard quantized reduce-scatter with topology-aware ring/one-shot
+    selection; the param all-gather stays full-precision).  A mesh with no
+    ``data`` axis > 1 deactivates it silently.
 
     ``cache_key`` (from ``runtime.compile_cache.train_cache_key``) opts into
     the in-process program memo: the caller asserts that equal keys mean an
@@ -459,6 +482,55 @@ def build_sharded_train(
             logical_specs, mesh, rules
         )
 
+    # ZeRO-1: re-shard the optimizer state (persistently, via the jitted
+    # in/out shardings) and derive the transient grad/param shard specs
+    # the update path pins through.  Shapes come from the eval_shape
+    # harvest with the flax metadata boxes collapsed to plain leaves, so
+    # the tree lines up 1:1 with the NamedSharding tree.
+    mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    zero1_active = bool(zero1) and mesh_sizes.get("data", 1) > 1
+    zero1_param_shardings = None
+    zero1_opt_shardings = None
+    zero1_stats = None
+    # The init program keeps the replicated-update shardings: with the
+    # non-partitionable threefry RNG the random bits depend on the layout
+    # GSPMD picks, so compiling init against zero1 out-shardings would
+    # yield DIFFERENT initial params than the replicated build (observed:
+    # 0.37 max abs diff) and no parity could hold.  Init stays bitwise
+    # identical; the opt state moves to its sharded layout via an explicit
+    # (value-preserving) device_put right after.
+    init_shardings = state_shardings
+    if zero1_active:
+        from dlrover_tpu.optimizers import zero1 as zero1_lib
+
+        def _unbox(leaf):
+            if isinstance(leaf, nn.meta.AxisMetadata):
+                return leaf.value
+            return leaf
+
+        abstract_plain = jax.tree.map(
+            _unbox, abstract_state,
+            is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+        )
+        zero1_opt_shardings, opt_stats = zero1_lib.shard_update_shardings(
+            mesh, abstract_plain.opt_state, state_shardings.opt_state
+        )
+        zero1_param_shardings, _ = zero1_lib.shard_update_shardings(
+            mesh, abstract_plain.params, state_shardings.params
+        )
+        state_shardings = state_shardings.replace(
+            opt_state=zero1_opt_shardings
+        )
+        zero1_stats = opt_stats
+        logger.info(
+            "zero1 sharded update: dp=%d, %d/%d opt-state leaves sharded "
+            "(%.1f -> %.1f MB/device)",
+            opt_stats["dp"], opt_stats["sharded_leaves"],
+            opt_stats["sharded_leaves"] + opt_stats["replicated_leaves"],
+            opt_stats["bytes_per_device_before"] / 1e6,
+            opt_stats["bytes_per_device_after"] / 1e6,
+        )
+
     token_sharding = logical_sharding(mesh, rules, lr.BATCH, lr.ACT_SEQ)
     batch_shardings = {
         "inputs": token_sharding,
@@ -495,6 +567,87 @@ def build_sharded_train(
             ce, total_weight = cross_entropy_loss(logits, targets, weights)
         return ce * total_weight, total_weight, aux
 
+    def _q_reduce_scatter_leaf(leaf, z_sharding, full_sharding):
+        """Route one gradient leaf's DP reduce through the int8 wire as a
+        per-shard reduce-scatter: each member keeps only its update shard,
+        so the quantized payload crosses the wire ONCE (the param
+        all-gather after the update stays full precision — satellite: the
+        int8 ratio applies to the reduce-scatter leg only)."""
+        from dlrover_tpu.optimizers.zero1 import data_axis_dim
+        from dlrover_tpu.parallel.quantized_collectives import (
+            axis_crosses_dcn,
+            quantized_all_reduce,
+            quantized_reduce_scatter,
+            select_reduce_algo,
+        )
+        from dlrover_tpu.runtime.mesh import shard_map_compat
+
+        dp = mesh_sizes["data"]
+        algo = select_reduce_algo(
+            dp,
+            payload_bytes=leaf.size * jnp.dtype(leaf.dtype).itemsize,
+            crosses_dcn=axis_crosses_dcn(mesh, "data"),
+        )
+        dim = data_axis_dim(z_sharding.spec)
+        if dim is None:
+            # Unshardable leaf (scalar / no divisible dim): replicated
+            # update, so it needs the full all-reduce.
+            fn = shard_map_compat(
+                lambda v: quantized_all_reduce(
+                    v, "data", mean=True, algo=algo
+                ),
+                mesh=mesh, in_specs=full_sharding.spec,
+                out_specs=full_sharding.spec,
+            )
+            return fn(leaf)
+        fn = shard_map_compat(
+            lambda v: quantized_reduce_scatter(
+                v, "data", dim=dim, mean=True, algo=algo
+            ),
+            mesh=mesh, in_specs=full_sharding.spec,
+            out_specs=z_sharding.spec,
+        )
+        return fn(leaf)
+
+    def _apply_update(state: TrainState, grads):
+        """Optimizer update: replicated (``apply_gradients``) or ZeRO-1.
+
+        The zero1 path is ``apply_gradients`` with three sharding pins
+        around it: grads pinned to the update shards (GSPMD lowers the DP
+        sum into a reduce-scatter — or the quantized collective runs it
+        explicitly), params pinned likewise (a free local slice of the
+        replicated copy), and the updated params pinned back to their
+        replicated layout (the all-gather).  Same math, 1/dp of the
+        update; XLA's scheduler overlaps the reduce-scatter with the tail
+        of the backward and the all-gather with the next step's host-side
+        dispatch since neither blocks any other step computation.
+        """
+        if not zero1_active:
+            return state.apply_gradients(grads=grads)
+        pin = jax.lax.with_sharding_constraint
+        if reduce_quant == "int8":
+            grads = jax.tree.map(
+                _q_reduce_scatter_leaf, grads, zero1_param_shardings,
+                state_shardings.params,
+            )
+        else:
+            grads = jax.tree.map(pin, grads, zero1_param_shardings)
+        params_sharded = jax.tree.map(
+            pin, state.params, zero1_param_shardings
+        )
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, params_sharded
+        )
+        new_params = optax.apply_updates(params_sharded, updates)
+        new_params = jax.tree.map(
+            pin, new_params, state_shardings.params
+        )
+        return state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+        )
+
     def _train_step(state: TrainState, batch: Dict[str, jax.Array]):
         TRACE_COUNTS["train_step"] += 1
 
@@ -509,7 +662,7 @@ def build_sharded_train(
         grads, (ce, aux, total_weight) = jax.grad(loss_fn, has_aux=True)(
             state.params
         )
-        new_state = state.apply_gradients(grads=grads)
+        new_state = _apply_update(state, grads)
         metrics = {
             "loss": ce,
             "aux_loss": aux,
@@ -580,7 +733,11 @@ def build_sharded_train(
             accum, (grads0, jnp.zeros((), jnp.float32),
                     jnp.zeros((), jnp.float32)), xs
         )
-        if reduce_quant == "int8" and "data" in mesh.axis_names:
+        if (
+            reduce_quant == "int8"
+            and "data" in mesh.axis_names
+            and not zero1_active
+        ):
             # Deferred once-per-step reduce on the int8 wire format.  Under
             # GSPMD the scanned grads are already globally summed, so over
             # the data axis this all-reduces data-replicated values: the
@@ -605,7 +762,7 @@ def build_sharded_train(
         grads = jax.tree.map(
             lambda g, p: g.astype(p.dtype), grads, state.params
         )
-        new_state = state.apply_gradients(grads=grads)
+        new_state = _apply_update(state, grads)
         metrics = {
             "loss": ce_sum / w_total,
             "aux_loss": aux_sum / grad_accum,
@@ -646,8 +803,18 @@ def build_sharded_train(
         return {"loss": ce, "aux_loss": aux, "tokens": total_weight}
 
     init_jit = jax.jit(
-        _wrap_with_rules(_init), out_shardings=state_shardings
+        _wrap_with_rules(_init), out_shardings=init_shardings
     )
+    if zero1_active:
+        _init_base = init_jit
+
+        def init_jit(rng):  # noqa: F811 - zero1 wrapper over the base init
+            state = _init_base(rng)
+            return state.replace(
+                opt_state=jax.device_put(
+                    state.opt_state, zero1_opt_shardings
+                )
+            )
     step_jit = jax.jit(
         _wrap_with_rules(_train_step),
         in_shardings=(state_shardings, batch_shardings),
@@ -673,6 +840,8 @@ def build_sharded_train(
         grad_accum=grad_accum,
         accum_dtype=accum_dtype,
         reduce_quant=reduce_quant,
+        zero1=zero1_active,
+        zero1_stats=zero1_stats,
         batch_avals={
             "inputs": token_aval,
             "targets": token_aval,
@@ -723,6 +892,7 @@ def microbatch_phase_plan(
     grad_accum: int,
     reduce_quant: str,
     step_seconds: float,
+    zero1: bool = False,
 ) -> list:
     """Modeled accumulate/reduce/update breakdown of one microbatched step.
 
@@ -735,7 +905,44 @@ def microbatch_phase_plan(
     with times relative to step start — consumed by the trainer's
     telemetry emission (attr ``source="modeled"``) and by
     ``tools/trace_steps.py``'s per-microbatch table.
+
+    ``zero1=True`` replaces the replicated reduce/update tail with the
+    sharded-update phases the trainer books as spans: ``reduce_scatter``
+    (half the all-reduce wire — est_comm_time's RS leg, where the int8
+    format applies), ``shard_update`` (1/dp of the optimizer FLOPs) and
+    ``allgather`` (the updated params riding back, full precision).  The
+    reduce_scatter overlaps the last microbatch's backward and the
+    allgather overlaps the next step's host work in the compiled program;
+    the modeled rows keep them sequential inside the measured span so the
+    timeline stays additive.
     """
+    if zero1:
+        rs_frac = 0.015 if reduce_quant == "int8" else 0.04
+        update_frac = 0.015
+        ag_frac = 0.04
+        accum_total = step_seconds * (
+            1.0 - rs_frac - update_frac - ag_frac
+        )
+        per_micro = accum_total / max(1, grad_accum)
+        rows = [
+            {
+                "phase": "accumulate", "micro": i,
+                "t0": i * per_micro, "dur": per_micro,
+            }
+            for i in range(grad_accum)
+        ]
+        t = accum_total
+        for phase, frac in (
+            ("reduce_scatter", rs_frac),
+            ("shard_update", update_frac),
+            ("allgather", ag_frac),
+        ):
+            rows.append({
+                "phase": phase, "micro": -1,
+                "t0": t, "dur": step_seconds * frac,
+            })
+            t += step_seconds * frac
+        return rows
     reduce_frac = 0.03 if reduce_quant == "int8" else 0.08
     update_frac = 0.04
     accum_total = step_seconds * (1.0 - reduce_frac - update_frac)

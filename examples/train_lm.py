@@ -74,7 +74,13 @@ def parse_args():
     p.add_argument("--reduce-quant", default="none",
                    help="wire format of the once-per-step deferred DP "
                         "gradient reduce: none (full precision) | int8 "
-                        "(block-quantized EQuARX-style all-reduce)")
+                        "(block-quantized EQuARX-style all-reduce; with "
+                        "--zero1, a quantized reduce-scatter)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 cross-replica sharded weight update: "
+                        "optimizer state + parameter update sharded over "
+                        "the data axis (1/dp the opt-state HBM), DP "
+                        "reduce lowered as reduce-scatter + all-gather")
     p.add_argument("--timeline", default="",
                    help="write this process's telemetry (step/compile/"
                         "checkpoint spans) as a Chrome-trace JSON at exit "
@@ -128,6 +134,7 @@ def main():
             grad_accum=args.grad_accum,
             accum_dtype=args.accum_dtype,
             reduce_quant=args.reduce_quant,
+            zero1=args.zero1,
         ),
         client=client,
     )

@@ -61,6 +61,7 @@ def run_trace(
     grad_accum: int = 1,
     accum_dtype: str = "float32",
     reduce_quant: str = "none",
+    zero1: bool = False,
 ) -> dict:
     """Train ``steps`` tiny steps and return the pipeline timeline.
 
@@ -96,6 +97,7 @@ def run_trace(
             grad_accum=grad_accum,
             accum_dtype=accum_dtype,
             reduce_quant=reduce_quant,
+            zero1=zero1,
         ),
         client=None,
     )
@@ -106,6 +108,7 @@ def run_trace(
     trainer.fit(batches, max_steps=steps)
     step_s = (time.perf_counter() - t0) / max(1, steps)
     resolved_accum = trainer.train.grad_accum
+    resolved_zero1 = trainer.train.zero1
     trainer.close()
     table = counters.per_step_table()
     summary = counters.summary()
@@ -117,15 +120,18 @@ def run_trace(
         "per_step": table,
         "summary": summary,
     }
-    if resolved_accum > 1:
-        # Microbatch engine active: attach the per-step phase breakdown
-        # (N accumulate rows + one deferred reduce + one update) the
-        # telemetry plane books under the step span — same model as
-        # train_lib.microbatch_phase_plan, scaled to the measured step.
+    if resolved_accum > 1 or resolved_zero1:
+        # Microbatch engine or ZeRO-1 active: attach the per-step phase
+        # breakdown (N accumulate rows + the reduce/update tail — or the
+        # reduce_scatter/shard_update/allgather tail when the update is
+        # sharded) the telemetry plane books under the step span — same
+        # model as train_lib.microbatch_phase_plan, scaled to the
+        # measured step.
         from dlrover_tpu.trainer import train_lib
 
         out["grad_accum"] = resolved_accum
         out["reduce_quant"] = reduce_quant
+        out["zero1"] = resolved_zero1
         out["microbatch_phases"] = [
             {
                 "phase": row["phase"],
@@ -134,7 +140,8 @@ def run_trace(
                 "dur_s": round(row["dur"], 6),
             }
             for row in train_lib.microbatch_phase_plan(
-                resolved_accum, reduce_quant, step_s
+                resolved_accum, reduce_quant, step_s,
+                zero1=resolved_zero1,
             )
         ]
     return out
@@ -158,6 +165,9 @@ def main() -> int:
     p.add_argument("--accum-dtype", default="float32")
     p.add_argument("--reduce-quant", default="none",
                    help="none | int8 (deferred DP reduce wire format)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 sharded weight update; adds "
+                        "reduce_scatter/shard_update/allgather phase rows")
     args = p.parse_args()
     out = run_trace(
         steps=args.steps,
@@ -173,19 +183,21 @@ def main() -> int:
         grad_accum=args.grad_accum,
         accum_dtype=args.accum_dtype,
         reduce_quant=args.reduce_quant,
+        zero1=args.zero1,
     )
     print(json.dumps(out, indent=2))
     if out.get("microbatch_phases"):
         print(
             f"\nmicrobatch phases (grad_accum={out['grad_accum']}, "
-            f"reduce_quant={out['reduce_quant']}, modeled within the "
+            f"reduce_quant={out['reduce_quant']}, "
+            f"zero1={out.get('zero1', False)}, modeled within the "
             f"measured step):",
             file=sys.stderr,
         )
         for row in out["microbatch_phases"]:
             micro = row["micro"] if row["micro"] >= 0 else "-"
             print(
-                f"  {row['phase']:<10} micro={micro:<3} "
+                f"  {row['phase']:<14} micro={micro:<3} "
                 f"t0={row['t0_s'] * 1e3:8.2f}ms "
                 f"dur={row['dur_s'] * 1e3:8.2f}ms",
                 file=sys.stderr,

@@ -227,6 +227,11 @@ class MasterClient:
         the heartbeat timeout."""
         self.report(msg.PreemptionNotice(self.node_id, grace_s, reason))
 
+    def report_digest(self, step: int, digest: str, check_every: int = 0):
+        """Ship one post-update state digest (trainer/state_digest.py) into
+        the master's SDC vote ledger."""
+        self.report(msg.DigestReport(self.node_id, step, digest, check_every))
+
     def report_telemetry(self, events, dropped: int = 0):
         """Ship one drained telemetry batch (common/telemetry.py wire
         tuples) to the master's job timeline."""

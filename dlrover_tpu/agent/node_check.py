@@ -80,6 +80,66 @@ def allreduce_probe(size_mb: int = 64) -> Tuple[float, float]:
     return dt, gb / dt if dt > 0 else 0.0
 
 
+def probe_result_digest(matrix_dim: int = 512, iters: int = 4) -> str:
+    """Deterministic digest of a seeded matmul chain's exact result bits.
+
+    The input is seeded (``PRNGKey(0)``) and the chain runs on local
+    device 0, so on healthy hardware the result is bit-identical run to
+    run — the node's *golden value*.  A re-join whose digest differs means
+    this chip now computes differently than it did at job start: the
+    suspicion-driven silent-data-corruption confirm probe (the agent-side
+    counterpart of the trainer's cross-replica state digest vote).
+    """
+    import zlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (matrix_dim, matrix_dim), jnp.bfloat16
+    )
+
+    @jax.jit
+    def chain(x):
+        for _ in range(iters):
+            x = x @ x
+            x = x * jax.lax.rsqrt(jnp.float32(matrix_dim)).astype(x.dtype)
+        return x
+
+    out = np.asarray(jax.device_get(chain(x)))
+    return f"{zlib.crc32(out.tobytes()) & 0xFFFFFFFF:08x}"
+
+
+def golden_replay_check(client, node_rank: int) -> bool:
+    """Record the golden probe digest at first join; compare on re-join.
+
+    The golden value lives in the master's kv store (it survives master
+    restarts through the state store), keyed by node rank.  A mismatch is
+    reported like a failed bisection round — the master's verdict then
+    excludes this host the same way a bad ICI link would be.
+    """
+    digest = probe_result_digest()
+    key = f"node_check_golden/{node_rank}"
+    golden = client.kv_get(key)
+    if not golden:
+        client.kv_put(key, digest.encode())
+        logger.info(
+            "node check: golden digest %s recorded for rank %d",
+            digest, node_rank,
+        )
+        return True
+    golden = golden.decode() if isinstance(golden, bytes) else str(golden)
+    if golden != digest:
+        logger.error(
+            "node check: golden digest mismatch on rank %d (recorded %s, "
+            "replayed %s) — hardware computes differently than at job "
+            "start (SDC suspect)", node_rank, golden, digest,
+        )
+        return False
+    return True
+
+
 def run_probe_payload(matrix_dim: int = 4096) -> Tuple[bool, float]:
     """The full per-host probe: returns (healthy, elapsed_seconds)."""
     import jax
@@ -127,6 +187,15 @@ def run_network_check(
                 break
             time.sleep(0.5)
         healthy, elapsed = run_probe_payload()
+        if check_round == 0:
+            # Golden-batch replay rides the first round only: one seeded
+            # matmul digest compared against the value recorded at the
+            # job's first join.  A mismatch fails this round exactly like
+            # a failed probe, feeding the master's bisection the suspect.
+            try:
+                healthy = golden_replay_check(client, node_rank) and healthy
+            except Exception as e:  # noqa: BLE001 - probe is best-effort
+                logger.warning("golden replay check skipped: %s", e)
         local_healthy = local_healthy and healthy
         client.report_network_status(node_rank, healthy, elapsed)
 

@@ -32,7 +32,7 @@ injected failures instead of blaming the job.
 Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``rpc.report``, ``rpc.get``, ``storage.write``, ``storage.read``,
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
-``preempt.notice``, ``rdzv.join``.
+``preempt.notice``, ``rdzv.join``, ``sdc.flip``.
 """
 
 from __future__ import annotations
@@ -65,6 +65,11 @@ KNOWN_SEAMS = (
     # breakpoint shm->storage flush a draining host races its grace window.
     "preempt.notice",
     "rdzv.join",
+    # Silent-data-corruption seam: a fired error here tells the trainer to
+    # flip one mantissa bit in its post-update state (state_digest.py's
+    # flipper) — modeling a chip that computes wrong numbers while every
+    # liveness monitor stays green.
+    "sdc.flip",
 )
 
 

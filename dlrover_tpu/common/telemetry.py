@@ -47,6 +47,24 @@ ENV_RING = "DLROVER_TPU_TELEMETRY_RING"
 
 _FALSY = ("0", "false", "off", "no")
 
+# Attribute names that collide with the ``span()``/``event()`` parameters
+# themselves.  An attrs dict carrying one of these used to either shadow a
+# parameter (an opaque ``TypeError: got multiple values for argument``) or
+# silently rebind the timing channel — reject loudly at the recording call
+# site instead.
+RESERVED_ATTRS = frozenset({"name", "duration_s", "t_mono"})
+
+
+def _check_attrs(attrs: Dict[str, Any]):
+    bad = RESERVED_ATTRS.intersection(attrs)
+    if bad:
+        raise ValueError(
+            f"telemetry attrs {sorted(bad)} are reserved parameters "
+            "(name/duration_s/t_mono); rename the attribute "
+            "(e.g. 'probe_duration_s'), or pass timing through the "
+            "duration_s/t_mono parameters"
+        )
+
 
 def _env_enabled() -> bool:
     return os.environ.get(ENV_ENABLE, "1").strip().lower() not in _FALSY
@@ -149,15 +167,20 @@ class TelemetryRecorder:
                 (name, kind, self._wall(t_mono), duration_s, attrs)
             )
 
-    def span(self, name: str, **attrs):
+    def span(self, name: str, /, **attrs):
         """Context manager timing a code region.  Nesting works naturally
         (each span records independently on exit); mutate ``.attrs`` inside
-        the block to attach results discovered mid-span."""
+        the block to attach results discovered mid-span.  Attrs named after
+        the reserved parameters (``RESERVED_ATTRS``) are rejected with
+        ``ValueError``.
+        """
+        if attrs:
+            _check_attrs(attrs)
         if not self.enabled:
             return _NULL_SPAN
         return _Span(self, name, attrs)
 
-    def event(self, name: str, duration_s: float = 0.0,
+    def event(self, name: str, /, duration_s: float = 0.0,
               t_mono: Optional[float] = None, **attrs):
         """Record an instant (or externally-timed) occurrence.
 
@@ -166,7 +189,22 @@ class TelemetryRecorder:
         microbatch engine's accumulate/reduce/update breakdown, which the
         host cannot observe inside one XLA program) are placed *inside*
         their enclosing measured span on the Chrome trace.
+
+        ``duration_s`` and ``t_mono`` are the timing channel, never attrs;
+        an attrs dict naming them (or ``name`` — see ``RESERVED_ATTRS``)
+        is rejected with ``ValueError`` — what used to surface as an opaque
+        ``TypeError: got multiple values`` or a silently-rebound duration.
         """
+        if attrs:
+            _check_attrs(attrs)
+        if not isinstance(duration_s, (int, float)) or isinstance(
+            duration_s, bool
+        ):
+            raise TypeError(
+                f"event({name!r}): duration_s must be seconds (a number), "
+                f"got {type(duration_s).__name__} — it is the reserved "
+                "timing parameter, not an attribute"
+            )
         if not self.enabled:
             return
         self._record("event" if duration_s == 0.0 else "span",
@@ -258,11 +296,11 @@ def recorder() -> TelemetryRecorder:
     return _RECORDER
 
 
-def span(name: str, **attrs):
+def span(name: str, /, **attrs):
     return _RECORDER.span(name, **attrs)
 
 
-def event(name: str, duration_s: float = 0.0,
+def event(name: str, /, duration_s: float = 0.0,
           t_mono: Optional[float] = None, **attrs):
     _RECORDER.event(name, duration_s=duration_s, t_mono=t_mono, **attrs)
 

@@ -134,6 +134,39 @@ class JobAutoScaler:
         self.speed_monitor.reset_running_speed()
         return plan
 
+    def note_quarantine(self, node_id: int) -> ScalePlan:
+        """A node was quarantined for silent data corruption: request a
+        replacement at a FRESH id, keeping the target unchanged.
+
+        Unlike ``note_preemption`` (capacity leaving — the target follows
+        the survivors), a quarantine is capacity going BAD: the job still
+        wants the same world size, and the regular repair loop can never
+        supply it because ``relaunchable()`` is pinned False for the
+        blacklisted id.  The replacement id is minted past the pool's
+        current maximum, exactly like a typed-pool migration.
+        """
+        statuses = self.node_manager.statuses(pool="worker")
+        new_id = max(statuses, default=-1) + 1
+        plan = ScalePlan(
+            target_nodes=self.target,
+            launch=[new_id],
+            delete=[node_id],
+            reason=f"quarantine of node {node_id}",
+        )
+        self.plans.append(plan)
+        logger.info(
+            "quarantine scale plan: delete=[%d] launch=[%d] target=%d",
+            node_id, new_id, plan.target_nodes,
+        )
+        # The quarantined host's launcher teardown already ran inside
+        # ``node_manager.quarantine``; only the replacement launch and the
+        # master-side retire bookkeeping remain.
+        self.node_manager.launch_node(new_id, bootstrap=True)
+        if self.retire_hook is not None:
+            self.retire_hook(node_id)
+        self.speed_monitor.reset_running_speed()
+        return plan
+
     def decide(self) -> ScalePlan:
         """Compare live inventory with the target; no side effects."""
         statuses = self.node_manager.statuses(pool="worker")

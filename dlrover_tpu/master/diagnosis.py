@@ -26,6 +26,7 @@ class ActionType:
     NONE = "none"
     RESTART_WORLD = "restart_world"   # break rendezvous; agents restart
     RELAUNCH_NODE = "relaunch_node"   # node-level relaunch via launcher
+    QUARANTINE = "quarantine"         # eject + blacklist a corrupting node
     REPORT = "report"                 # surfaced only (operator judgment)
 
 
@@ -231,6 +232,66 @@ class NumericAnomalyOperator(InferenceOperator):
         return out
 
 
+class SDCVoteOperator(InferenceOperator):
+    """Silent-data-corruption attribution from the digest ledger.
+
+    Every replica's post-update state digest (trainer/state_digest.py) is
+    majority-voted per step by the speed monitor; a node voted into the
+    minority on ``STREAK_THRESHOLD`` consecutive checks is computing wrong
+    numbers — quarantine it (blacklist + eject + replace) and restart the
+    world onto the last verified checkpoint.  A single transient mismatch
+    (one flipped bit in activation memory, a racy read) only surfaces a
+    REPORT that asks the agent for a golden-batch confirm probe; the
+    quarantine trigger must be persistent state corruption, which the
+    checkpoint restore cannot wash out.
+    """
+
+    name = "sdc_vote"
+    STREAK_THRESHOLD = 2  # consecutive minority votes before quarantine
+
+    def __init__(self):
+        # Same one-shot latch as NumericAnomalyOperator: a mismatch count
+        # that stopped moving must not re-trigger every control tick.
+        self._consumed_mismatches = 0
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        sm = ctx.speed_monitor
+        ledger = getattr(sm, "sdc_ledger", lambda: None)()
+        if not ledger or not ledger["mismatches"]:
+            return []
+        out: List[DiagnosisAction] = []
+        fresh = ledger["mismatches"] > self._consumed_mismatches
+        # Latch NOW: whatever this tick surfaces (confirm REPORT or
+        # QUARANTINE), the same mismatch count must not re-trigger it
+        # every control tick — only fresh evidence reopens the gate.
+        self._consumed_mismatches = ledger["mismatches"]
+        for node_id, streak in sorted(ledger["streaks"].items()):
+            if streak >= self.STREAK_THRESHOLD:
+                out.append(DiagnosisAction(
+                    ActionType.QUARANTINE,
+                    reason=(
+                        f"node {node_id} SDC: state digest in the minority "
+                        f"on {streak} consecutive checks (last mismatch at "
+                        f"step {ledger['last_mismatch_step']}) — "
+                        "quarantining and restoring last verified checkpoint"
+                    ),
+                    node_id=node_id,
+                    severity=4,
+                ))
+            elif fresh:
+                out.append(DiagnosisAction(
+                    ActionType.REPORT,
+                    reason=(
+                        f"node {node_id} SDC suspect: transient digest "
+                        f"mismatch at step {ledger['last_mismatch_step']} — "
+                        "golden-batch confirm probe advised"
+                    ),
+                    node_id=node_id,
+                    severity=1,
+                ))
+        return out
+
+
 class InferenceChain:
     """Run the operators, combine evidence, rank the produced actions.
 
@@ -247,6 +308,7 @@ class InferenceChain:
             NodeFlappingOperator(),
             StragglerOperator(),
             NumericAnomalyOperator(),
+            SDCVoteOperator(),
         ]
 
     def infer(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:

@@ -319,6 +319,26 @@ class JobMaster:
                 # force teardown + relaunch, not the repair-path launch that
                 # no-ops on RUNNING nodes.
                 self.node_manager.force_relaunch(action.node_id)
+            elif action.action == ActionType.QUARANTINE:
+                self._quarantine_node(action.node_id, action.reason)
+
+    def _quarantine_node(self, node_id: int, reason: str):
+        """Eject a silently-corrupting host: blacklist it, ban it from every
+        rendezvous, request a replacement, and restart the world onto the
+        last verified checkpoint (the survivors' re-join goes through the
+        cross-world restore path, which drops the poisoned in-memory
+        state)."""
+        self.node_manager.quarantine(node_id, reason)
+        for manager in self.rdzv_managers.values():
+            manager.ban_node(node_id)
+            manager.invalidate_world()
+        self.servicer.sync_service.remove_node(node_id)
+        self.task_manager.recover_tasks(node_id)
+        self.speed_monitor.record_sdc_quarantine(node_id)
+        self.speed_monitor.begin_resize(reason=f"quarantine:{node_id}")
+        self.speed_monitor.reset_running_speed()
+        if self.auto_scaler is not None:
+            self.auto_scaler.note_quarantine(node_id)
 
     def _handle_node_death(self, node_id: int):
         """Silent host death (heartbeat timeout) gets the same recovery as a

@@ -183,6 +183,22 @@ class StepReport:
 
 
 @dataclasses.dataclass
+class DigestReport:
+    """One replica's post-update train-state digest (trainer/state_digest.py).
+
+    After the ZeRO-1 all-gather (or the replicated update) all DP replicas
+    hold bitwise-identical state, so the master can majority-vote the
+    per-node digests for a given step and attribute a silent-data-corruption
+    outlier without any extra collective.  ``check_every`` rides along so
+    the ledger can report the configured cadence in its metrics."""
+
+    node_id: int
+    step: int
+    digest: str
+    check_every: int = 0
+
+
+@dataclasses.dataclass
 class HeartBeat:
     node_id: int
     timestamp: float = dataclasses.field(default_factory=time.time)

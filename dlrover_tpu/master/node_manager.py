@@ -159,6 +159,10 @@ class NodeManager:
         # Migrations in flight: new_id -> old_id (retire the old host
         # once its replacement reports in).
         self._migrations: Dict[int, int] = {}
+        # SDC quarantine blacklist: node_id -> reason.  A quarantined host
+        # computes wrong numbers — it is never relaunched, never rejoins a
+        # rendezvous, and the ban survives master restarts (state_store).
+        self._quarantined: Dict[int, str] = {}
         # Event callbacks: fn(node_id, old_status, new_status).
         self._callbacks: List[Callable[[int, NodeStatus, NodeStatus], None]] = []
         self.job_failed = False
@@ -274,6 +278,11 @@ class NodeManager:
     def _maybe_relaunch(self, node: NodeState) -> bool:
         """ref ``_should_relaunch:561``: relaunch unless budget exhausted or
         the failure is fatal (exit code classified as unrecoverable)."""
+        if node.node_id in self._quarantined:
+            logger.info(
+                "node %d is quarantined; not relaunching", node.node_id
+            )
+            return False
         if node.relaunch_count >= node.max_relaunches:
             self.job_failed = True
             self.job_failure_reason = (
@@ -288,8 +297,34 @@ class NodeManager:
 
     def relaunchable(self, node_id: int) -> bool:
         with self._lock:
+            if node_id in self._quarantined:
+                return False
             node = self._nodes.get(node_id)
             return node is None or node.relaunch_count < node.max_relaunches
+
+    def quarantine(self, node_id: int, reason: str = ""):
+        """Blacklist a silently-corrupting host: retire it and pin its
+        relaunch budget to zero so neither the auto-scaler's repair loop
+        nor a node-level failure path ever brings it back."""
+        with self._lock:
+            if node_id in self._quarantined:
+                return
+            self._quarantined[node_id] = reason
+            node = self.ensure_node(node_id)
+            self._transition(node, NodeStatus.FAILED)
+            node.error = reason or "quarantined"
+        logger.warning(
+            "node %d QUARANTINED: %s", node_id, reason or "SDC suspect"
+        )
+        self._launcher.delete(node_id)
+
+    def is_quarantined(self, node_id: int) -> bool:
+        with self._lock:
+            return node_id in self._quarantined
+
+    def quarantined(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._quarantined)
 
     def launch_node(self, node_id: int, bootstrap: bool = False) -> bool:
         """Scaler entry: (re)launch a host if its relaunch budget remains.
@@ -304,6 +339,11 @@ class NodeManager:
         """
         with self._lock:
             node = self.ensure_node(node_id)
+            if node_id in self._quarantined:
+                logger.warning(
+                    "node %d is quarantined; refusing launch", node_id
+                )
+                return False
             if node_id in self._migrations.values():
                 # The draining side of an in-flight migration (it may
                 # have gone silent — the normal preemption signature):
@@ -445,6 +485,8 @@ class NodeManager:
                     "status": n.status.value,
                     "relaunch_count": n.relaunch_count,
                     "max_relaunches": n.max_relaunches,
+                    "quarantined": i in self._quarantined,
+                    "quarantine_reason": self._quarantined.get(i, ""),
                 }
                 for i, n in self._nodes.items()
             }
@@ -455,6 +497,12 @@ class NodeManager:
         Budget-limited like every other relaunch path."""
         with self._lock:
             node = self.ensure_node(node_id)
+            if node_id in self._quarantined:
+                logger.warning(
+                    "node %d is quarantined; refusing force relaunch",
+                    node_id,
+                )
+                return False
             if node.relaunch_count >= node.max_relaunches:
                 logger.warning(
                     "node %d relaunch budget exhausted (force)", node_id
@@ -481,5 +529,5 @@ class NodeManager:
             return all(
                 n.status == NodeStatus.SUCCEEDED
                 for n in self._nodes.values()
-                if n.node_type == "worker"
+                if n.node_type == "worker" and n.node_id not in self._quarantined
             )

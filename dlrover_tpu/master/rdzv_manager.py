@@ -60,6 +60,9 @@ class RendezvousManager(ABC):
         # polling ``world_changed`` must restart and re-join so a smaller
         # world can seal (the scale-down half of membership detection).
         self._world_broken = False
+        # Quarantined ranks: join_rendezvous ignores them, so a corrupting
+        # host that keeps heartbeating can never re-enter a world.
+        self._banned: set = set()
 
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int,
@@ -104,9 +107,27 @@ class RendezvousManager(ABC):
         with self._lock:
             return self._rdzv_round > round_ or self._world_broken
 
+    def ban_node(self, node_rank: int):
+        """Quarantine: evict the rank from waiting/alive/sealed sets and
+        refuse every future join.  Breaks the sealed world if the rank was
+        a member, exactly like a death — survivors re-form without it."""
+        with self._lock:
+            self._banned.add(node_rank)
+        self.remove_alive_node(node_rank)
+        logger.warning(
+            "%s: node %d banned from rendezvous (quarantine)",
+            self.name, node_rank,
+        )
+
     def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
         """Register a host; returns the round it will join."""
         with self._lock:
+            if node_rank in self._banned:
+                logger.warning(
+                    "%s: refusing join from quarantined node %d",
+                    self.name, node_rank,
+                )
+                return self._rdzv_round
             if not self._waiting_nodes:
                 self._start_rdzv_time = time.monotonic()
             self._waiting_nodes[node_rank] = local_world_size

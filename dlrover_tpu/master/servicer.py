@@ -83,6 +83,7 @@ class MasterServicer:
             msg.ResourceStats: self._report_resource,
             msg.ShardCheckpoint: self._restore_shard_checkpoint,
             msg.TelemetryEvents: self._report_telemetry,
+            msg.DigestReport: self._report_digest,
         }
 
     # -- RPC entry points -----------------------------------------------------
@@ -193,6 +194,8 @@ class MasterServicer:
     def _report_step(self, env: msg.Envelope):
         p: msg.StepReport = env.payload
         self.speed_monitor.collect_global_step(p.step, p.timestamp, p.tokens)
+        if p.loss:
+            self.speed_monitor.record_loss(p.step, p.loss)
         for encoded in getattr(p, "anomalies", ()):
             self.speed_monitor.record_anomaly(p.step, str(encoded))
 
@@ -251,6 +254,22 @@ class MasterServicer:
                 attrs={"grace_s": p.grace_s, "reason": p.reason,
                        "src": "master"},
             )
+
+    def _report_digest(self, env: msg.Envelope):
+        """Route one replica's state digest into the SDC vote ledger."""
+        p: msg.DigestReport = env.payload
+        if self.speed_monitor is None:
+            return
+        node = p.node_id if p.node_id >= 0 else env.node_id
+        if self.node_manager is not None and self.node_manager.is_quarantined(
+            node
+        ):
+            # A quarantined host keeps shipping until its agent tears the
+            # trainer down; its digests must not re-enter the vote.
+            return
+        self.speed_monitor.record_digest(
+            node, p.step, p.digest, p.check_every
+        )
 
     def _report_event(self, env: msg.Envelope):
         p: msg.NodeEventReport = env.payload

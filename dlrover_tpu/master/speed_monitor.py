@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 
 class SpeedMonitor:
@@ -53,6 +53,23 @@ class SpeedMonitor:
         self._resize_s_total = 0.0
         self._resize_started: Optional[float] = None
         self._resizes_by_reason: Dict[str, int] = {}
+        # SDC digest ledger (trainer/state_digest.py DigestReports): votes
+        # are per-step {node: digest} maps; a step is voted once, when a
+        # NEWER step's report proves every replica that will ever report it
+        # has (the watermark).  Persistent minority == the corrupting node.
+        self._digest_votes: Dict[int, Dict[int, str]] = {}
+        self._sdc_checks = 0
+        self._sdc_mismatches = 0
+        self._sdc_quarantines = 0
+        self._sdc_streaks: Dict[int, int] = {}
+        self._sdc_last_mismatch_step = -1
+        self._sdc_check_every = 0
+        # Per-node digest watermark: newest step each reporter has voted.
+        self._sdc_latest: Dict[int, int] = {}
+        # Recent (step, loss) samples from StepReports: the SDC drill's
+        # post-restore parity check compares the recovered trajectory's
+        # tail against an uninjected reference run.
+        self._recent_losses: Deque[Tuple[int, float]] = deque(maxlen=512)
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -78,6 +95,17 @@ class SpeedMonitor:
             self._global_step = step
             self._tokens_cum += tokens
             self._samples.append((ts, step, self._tokens_cum))
+
+    def record_loss(self, step: int, loss: float):
+        """Retain a trainer-reported loss sample (newest-wins per step)."""
+        with self._lock:
+            self._recent_losses.append((step, float(loss)))
+
+    def recent_losses(self, last_n: int = 0) -> List[Tuple[int, float]]:
+        """[(step, loss)] oldest first; the tail ``last_n`` if requested."""
+        with self._lock:
+            out = list(self._recent_losses)
+        return out[-last_n:] if last_n else out
 
     def record_anomaly(self, step: int, encoded: str):
         """Numeric anomaly reported by a trainer (kind@step:detail); feeds
@@ -123,6 +151,86 @@ class SpeedMonitor:
                 "fault_events": self._fault_events,
                 "fault_lost_s": self._fault_lost_s,
                 "by_seam": dict(self._faults_by_seam),
+            }
+
+    def record_digest(
+        self, node_id: int, step: int, digest: str, check_every: int = 0
+    ):
+        """One replica's post-update state digest for ``step``.
+
+        Votes finalize behind a *per-node* watermark: a pending step is
+        voted only once every known reporter has delivered a digest for a
+        later step (replicas run minutes apart across restarts; a global
+        watermark would finalize a fast node's steps before the slow
+        nodes' votes arrive and drop them as single-report steps).  The
+        watermark is an assignment, not a max — a post-restore rewind
+        legitimately moves a replica's stream backward, and its re-voted
+        steps overwrite the pre-restart digests by node key.  A reporter
+        that vanishes without being quarantined would stall the pipeline,
+        so steps more than four check intervals behind the fastest
+        reporter force-finalize with whatever votes arrived; finalized
+        steps with fewer than two votes carry no cross-replica
+        information and are dropped silently.
+        """
+        with self._lock:
+            if check_every:
+                self._sdc_check_every = check_every
+            self._digest_votes.setdefault(step, {})[node_id] = digest
+            self._sdc_latest[node_id] = step
+            low = min(self._sdc_latest.values())
+            high = max(self._sdc_latest.values())
+            horizon = max(low, high - 4 * max(self._sdc_check_every, 1))
+            for pending in sorted(self._digest_votes):
+                if pending >= horizon:
+                    break
+                self._vote_locked(pending, self._digest_votes.pop(pending))
+
+    def _vote_locked(self, step: int, votes: Dict[int, str]):
+        if len(votes) < 2:
+            return
+        self._sdc_checks += 1
+        tally: Dict[str, int] = {}
+        for digest in votes.values():
+            tally[digest] = tally.get(digest, 0) + 1
+        majority = max(tally, key=lambda d: (tally[d], d))
+        outliers = [n for n, d in votes.items() if d != majority]
+        if outliers and tally[majority] > len(outliers):
+            self._sdc_mismatches += 1
+            self._sdc_last_mismatch_step = step
+            for node in votes:
+                if node in outliers:
+                    self._sdc_streaks[node] = (
+                        self._sdc_streaks.get(node, 0) + 1
+                    )
+                else:
+                    self._sdc_streaks.pop(node, None)
+        else:
+            # Unanimous (or a tie with no majority to trust): every
+            # reporter's streak resets — corruption must be persistent.
+            for node in votes:
+                self._sdc_streaks.pop(node, None)
+
+    def record_sdc_quarantine(self, node_id: int = -1):
+        """A QUARANTINE action executed; the node's streak is consumed and
+        its pending votes dropped (the world restarts without it)."""
+        with self._lock:
+            self._sdc_quarantines += 1
+            self._sdc_streaks.pop(node_id, None)
+            # Drop it from the watermark too, or the dead node's frozen
+            # latest-step would gate every future vote.
+            self._sdc_latest.pop(node_id, None)
+            for votes in self._digest_votes.values():
+                votes.pop(node_id, None)
+
+    def sdc_ledger(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "checks": self._sdc_checks,
+                "mismatches": self._sdc_mismatches,
+                "quarantines": self._sdc_quarantines,
+                "streaks": dict(self._sdc_streaks),
+                "last_mismatch_step": self._sdc_last_mismatch_step,
+                "check_every": self._sdc_check_every,
             }
 
     def begin_resize(self, reason: str = ""):

@@ -114,6 +114,16 @@ class MasterStateStore:
         for node_id, saved in state.get("nodes", {}).items():
             node = master.node_manager.ensure_node(int(node_id))
             node.relaunch_count = saved.get("relaunch_count", 0)
+            if saved.get("quarantined"):
+                # A quarantined (silently-corrupting) host must stay out
+                # after a master restart: re-blacklist it and re-ban its
+                # rendezvous rank so a re-join attempt cannot re-admit it.
+                master.node_manager.quarantine(
+                    int(node_id),
+                    saved.get("quarantine_reason", "restored quarantine"),
+                )
+                for manager in master.rdzv_managers.values():
+                    manager.ban_node(int(node_id))
         for key, value in state.get("kv", {}).items():
             try:
                 master.kv_store.put(key, bytes.fromhex(value))

@@ -247,6 +247,13 @@ class JobTimeline:
                   resize["resize_s_total"] + resize["resize_open_s"],
                   "wall seconds between a resize notice and the next "
                   "step advance (open window included)")
+            sdc = speed_monitor.sdc_ledger()
+            gauge("dlrover_sdc_checks_total", sdc["checks"],
+                  "cross-replica state-digest votes performed")
+            gauge("dlrover_sdc_mismatch_total", sdc["mismatches"],
+                  "digest votes with a minority (SDC suspect) replica")
+            gauge("dlrover_sdc_quarantines_total", sdc["quarantines"],
+                  "nodes quarantined by the SDC vote operator")
             anomalies = speed_monitor.recent_anomalies()
             kinds: Counter = Counter(
                 encoded.split("@", 1)[0] for _, _, encoded in anomalies

@@ -27,13 +27,13 @@ import jax
 import numpy as np
 import optax
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import faults, telemetry
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 from dlrover_tpu.parallel import rules as lr
 from dlrover_tpu.runtime import compile_cache, env as renv
 from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
-from dlrover_tpu.trainer import train_lib
+from dlrover_tpu.trainer import state_digest, train_lib
 from dlrover_tpu.utils.profiler import pipeline_counters
 
 
@@ -97,6 +97,13 @@ class TrainerConfig:
     # parameter update sharded over the data axis, DP reduce lowered as
     # reduce-scatter + all-gather (optimizers/zero1.py).
     zero1: bool = False
+    # -- silent data corruption ---------------------------------------------
+    # Every N steps, digest the post-update train state on device
+    # (trainer/state_digest.py) and queue it for the master's cross-replica
+    # vote ledger; after the ZeRO-1 all-gather every DP replica holds
+    # bitwise-identical state, so a minority digest pins the corrupting
+    # host.  0 disables: no digest program is built, nothing is allocated.
+    sdc_check_every: int = 0
     # World size ``grad_accum`` was chosen for; 0 = the world at first
     # construction.  Booked in checkpoint `extra` so a restore into a
     # different world recomputes N from the ORIGINAL reference pairing.
@@ -194,6 +201,12 @@ class ElasticTrainer:
         # Deferred-metrics ring: (step, device_metrics) pairs awaiting the
         # single batched fetch in _flush_metrics.
         self._metrics_ring: List[Tuple[int, Dict[str, Any]]] = []
+        # SDC sentry: lazily-built digest program (rebuilt when self.train
+        # is) and (step, device_digest) pairs awaiting the report-cadence
+        # ship — the fetch happens off the step's critical path.
+        self._digest_fn = None
+        self._digest_train = None
+        self._pending_digests: List[Tuple[int, Any]] = []
         self._on_step: Optional[Callable[[int, Dict], None]] = None
         self._fit_max_steps = 0
         # Restart-fast compile, layer 1: persistent XLA cache so a restarted
@@ -375,6 +388,11 @@ class ElasticTrainer:
             pipeline_counters().record_dispatch(
                 self.step, time.perf_counter() - t0
             )
+            every = self.config.sdc_check_every
+            if every > 0 and self.step % every == 0:
+                # Booked inside the step span: the digest dispatch is part
+                # of the step's host-observed cost at its check cadence.
+                self._sdc_check()
         if (
             self.train.grad_accum > 1 or self.train.zero1
         ) and telemetry.recorder().enabled:
@@ -394,6 +412,33 @@ class ElasticTrainer:
                 )
         self._last_metrics = metrics
         return metrics
+
+    # -- silent data corruption ------------------------------------------------
+
+    def _sdc_check(self):
+        """Digest the post-update state on device and queue it for the
+        master's cross-replica vote (shipped on the report cadence).
+
+        The ``sdc.flip`` chaos seam fires HOST-side here — never inside a
+        traced function — so the drill corrupts one replica's live state
+        without touching the compiled step program: trace purity and the
+        zero-retrace contract both hold, and the corruption persists into
+        every later step exactly like a real SDC event would.
+        """
+        try:
+            faults.fire("sdc.flip", step=self.step)
+        except faults.FaultInjected as e:
+            logger.warning(
+                "sdc.flip: flipping one mantissa bit in the live state (%s)",
+                e,
+            )
+            self.state = state_digest.flip_mantissa_bit(self.state)
+        if self._digest_fn is None or self._digest_train is not self.train:
+            self._digest_fn = state_digest.build_digest_fn(self.train)
+            self._digest_train = self.train
+        with train_lib.use_mesh(self.train.mesh):
+            value = self._digest_fn(self.state)
+        self._pending_digests.append((self.step, value))
 
     def _batch_stream(self, loader: Iterable) -> Iterable:
         """Wrap ``loader`` in a DevicePrefetcher when configured, so batch
@@ -681,6 +726,16 @@ class ElasticTrainer:
             # Piggyback the telemetry drain on the report cadence: one
             # extra RPC per report window, never per step.
             telemetry.recorder().ship(self.client)
+            if self._pending_digests:
+                # Digest fetch + ship rides the same cadence: the uint32
+                # scalars materialize here, off the step critical path.
+                pending, self._pending_digests = self._pending_digests, []
+                for dstep, value in pending:
+                    self.client.report_digest(
+                        dstep,
+                        state_digest.format_digest(value),
+                        check_every=cfg.sdc_check_every,
+                    )
         from dlrover_tpu.agent.monitor import write_device_metrics
 
         write_device_metrics()

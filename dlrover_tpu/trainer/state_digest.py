@@ -1,0 +1,112 @@
+"""On-device train-state digest for silent-data-corruption detection.
+
+After the update (for ZeRO-1, after the param all-gather) every DP replica
+must hold bitwise-identical train state — params, optimizer state and the
+integer step counter alike.  A cheap jit-fused reduction over the raw bits
+of every state leaf turns that invariant into ONE uint32 scalar per
+replica; the master's digest ledger majority-votes the scalars and a
+persistent minority identifies the corrupting node with no extra
+collectives, no second program, and no host-side tree walk.
+
+Digest construction: each leaf is bitcast to bytes, widened to uint32 and
+summed (mod 2^32), then folded into a running accumulator with an odd
+multiplier (``acc = acc * 1000003 + leaf_sum``).  The multiplier is odd —
+hence invertible mod 2^32 — so a single flipped bit anywhere in any leaf
+provably changes the digest: the byte delta is non-zero mod 2^32 and the
+fold is linear in it.  This is not a cryptographic hash; it is a
+corruption detector whose cost is one elementwise pass XLA fuses into a
+handful of reductions.
+
+The staged function bumps ``train_lib.TRACE_COUNTS["state_digest"]`` so
+the retrace accounting covers it exactly like the train step: one trace at
+the first check, zero after (asserted via
+``trace_asserts.assert_no_retrace``).  With ``sdc_check_every=0`` nothing
+here is ever built or called — the disabled path allocates nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from dlrover_tpu.trainer import train_lib
+
+
+def _leaf_sum(leaf: jax.Array) -> jax.Array:
+    """uint32 byte-sum of one leaf's raw bits (dtype-agnostic)."""
+    if leaf.ndim == 0:
+        leaf = leaf[None]
+    if jnp.issubdtype(leaf.dtype, jnp.bool_):
+        leaf = leaf.astype(jnp.uint8)
+    words = jax.lax.bitcast_convert_type(leaf, jnp.uint8)
+    return jnp.sum(words.astype(jnp.uint32), dtype=jnp.uint32)
+
+
+def _digest_tree(state: Any) -> jax.Array:
+    """Order-sensitive fold of every array leaf into one uint32 scalar."""
+    train_lib.TRACE_COUNTS["state_digest"] += 1
+    acc = jnp.zeros((), jnp.uint32)
+    for leaf in jax.tree.leaves(state):
+        acc = acc * jnp.uint32(1000003) + _leaf_sum(leaf)
+    return acc
+
+
+def build_digest_fn(train: "train_lib.ShardedTrain"):
+    """Jit the digest against the program's state shardings.
+
+    The result is pinned replicated so the host reads one scalar; computing
+    it inside the step span costs one fused device program (launched async,
+    it overlaps the host-side dispatch of the next step).
+    """
+    out_sharding = NamedSharding(train.mesh, PartitionSpec())
+    return jax.jit(
+        _digest_tree,
+        in_shardings=(train.state_shardings,),
+        out_shardings=out_sharding,
+    )
+
+
+def format_digest(value) -> str:
+    """Device scalar -> canonical 8-hex-digit wire form."""
+    return f"{int(value) & 0xFFFFFFFF:08x}"
+
+
+def flip_mantissa_bit(
+    state: Any,
+    *,
+    bit: int = 10,
+    leaf_index: int = 0,
+    flat_index: int = 0,
+) -> Any:
+    """Deterministically flip ONE mantissa bit in one param leaf.
+
+    The certification half of the ``sdc.flip`` Faultline seam: the trainer
+    fires the seam host-side right after the update and, when the plan says
+    so, routes the post-update state through this flipper — the compiled
+    step program is untouched, so the fault models a chip writing one wrong
+    bit without perturbing the measured pipeline.  Bit 10 of a float32
+    mantissa is a ~1e-4 relative wiggle: big enough for the digest vote,
+    small enough that training would otherwise look healthy.
+    """
+    leaves, treedef = jax.tree.flatten(state.params)
+    idx = leaf_index % len(leaves)
+    leaf = leaves[idx]
+    host = np.asarray(jax.device_get(leaf)).copy()
+    flat = host.reshape(-1)
+    pos = flat_index % flat.size
+    if host.dtype.itemsize == 4:
+        view = flat.view(np.uint32)
+        view[pos] ^= np.uint32(1) << (bit % 23)
+    elif host.dtype.itemsize == 2:
+        view = flat.view(np.uint16)
+        view[pos] ^= np.uint16(1) << (bit % 7)
+    else:
+        view = flat.view(np.uint8)
+        view[pos * host.dtype.itemsize] ^= np.uint8(1) << (bit % 8)
+    leaves[idx] = jax.device_put(host, leaf.sharding)
+    new_params = jax.tree.unflatten(treedef, leaves)
+    return state.replace(params=new_params)

@@ -81,6 +81,17 @@ def parse_args():
                         "optimizer state + parameter update sharded over "
                         "the data axis (1/dp the opt-state HBM), DP "
                         "reduce lowered as reduce-scatter + all-gather")
+    p.add_argument("--sdc-check-every", type=int, default=0,
+                   help="silent-data-corruption sentry: every N steps, "
+                        "digest the post-update train state on device and "
+                        "ship it to the master's cross-replica vote ledger "
+                        "(0 = off)")
+    p.add_argument("--lockstep-data", action="store_true",
+                   help="skip master data sharding so every node consumes "
+                        "the identical sequential sample stream — required "
+                        "for the SDC drill on CPU worlds, where each node "
+                        "is its own data replica and digests only agree if "
+                        "the replicas train on the same batches")
     p.add_argument("--timeline", default="",
                    help="write this process's telemetry (step/compile/"
                         "checkpoint spans) as a Chrome-trace JSON at exit "
@@ -135,6 +146,7 @@ def main():
             accum_dtype=args.accum_dtype,
             reduce_quant=args.reduce_quant,
             zero1=args.zero1,
+            sdc_check_every=args.sdc_check_every,
         ),
         client=client,
     )
@@ -148,7 +160,7 @@ def main():
             f"{n_proc}-host world"
         )
     local_batch = args.batch_size // n_proc
-    if client is not None:
+    if client is not None and not args.lockstep_data:
         loader_source = ShardingClient(
             client,
             "train",

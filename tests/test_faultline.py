@@ -66,6 +66,33 @@ def test_plan_grammar_accepts_the_documented_forms():
     assert by_seam["backend.init"].should_fire(1, random.Random(0))
 
 
+def test_sdc_flip_seam_is_known_and_plans_parse():
+    """The SDC drill's seam speaks the standard grammar: one-shot hit,
+    every-N cadence, and probabilistic forms all parse, and the seam is
+    registered (a typo'd seam in a drill plan warns as unknown)."""
+    assert "sdc.flip" in faults.KNOWN_SEAMS
+    rules = faults.parse_plan(
+        "sdc.flip:error@2;rpc.report:delay=0.1@every:3"
+    )
+    flip = {r.seam: r for r in rules}["sdc.flip"]
+    assert flip.kind == "error" and flip.hits == {2}
+    assert faults.parse_plan("sdc.flip:error@every:5")[0].every == 5
+    assert faults.parse_plan("sdc.flip:error@p=0.5")[0].prob == 0.5
+
+
+def test_sdc_flip_fires_deterministically_at_the_scripted_hit():
+    faults.configure("sdc.flip:error@2", seed=7)
+    for hit in (1, 2, 3):
+        if hit == 2:
+            with pytest.raises(faults.FaultInjected) as ei:
+                faults.fire("sdc.flip", step=hit * 8)
+            assert ei.value.seam == "sdc.flip" and ei.value.hit == 2
+        else:
+            faults.fire("sdc.flip", step=hit * 8)
+    plan = faults.active()
+    assert plan is not None and ("sdc.flip", "error", 2) in plan.fired
+
+
 @pytest.mark.parametrize("bad", [
     "storage.write",                 # no kind
     "storage.write:explode",         # unknown kind

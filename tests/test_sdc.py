@@ -1,0 +1,554 @@
+"""Sentry: silent-data-corruption detection end to end, minus the drill.
+
+Covers the four layers at unit/integration scope:
+
+* **digest** — determinism, layout-independence (replicated vs zero1),
+  and sensitivity: one flipped mantissa bit changes the digest under
+  EVERY build shape (grad-accum on/off, int8 reduce), deterministically.
+* **vote** — the speed monitor's watermark-finalized majority vote with
+  node attribution, streak bookkeeping, and tie handling.
+* **decide** — SDCVoteOperator thresholds (confirm REPORT vs QUARANTINE)
+  and the master's quarantine execution: blacklist, rendezvous ban,
+  replacement launch, state-store persistence across a master restart.
+* **trainer** — the check rides the step span at its cadence with zero
+  retraces, and ships digests on the report cadence.
+
+The chaos certifier (inject -> vote -> quarantine -> restore on live
+agents) lives in ``tools/goodput_bench.py --sdc-drill``.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import faults
+from dlrover_tpu.master import messages as msg
+from dlrover_tpu.master.cloud_launcher import (
+    CloudNodeLauncher,
+    FakeTpuVmClient,
+)
+from dlrover_tpu.master.diagnosis import (
+    ActionType,
+    DiagnosisContext,
+    InferenceChain,
+    SDCVoteOperator,
+)
+from dlrover_tpu.master.job_master import JobMaster
+from dlrover_tpu.master.node_manager import NodeManager, NodeStatus
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.transformer import TransformerLM
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.trainer import state_digest, train_lib
+
+import trace_asserts
+
+TINY = gpt2_config(
+    "124m", num_layers=2, d_model=64, num_heads=4,
+    vocab_size=256, max_seq_len=64,
+)
+
+
+def _make_batch(batch=32, seq=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, vocab, size=(batch, seq + 1), dtype=np.int32)
+    return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+def _build(zero1=False, grad_accum=1, reduce_quant="none",
+           batch=32, seq=16, parallel=ParallelConfig(data=4, fsdp=2)):
+    mesh = build_mesh(parallel)
+    model = TransformerLM(TINY)
+    opt = train_lib.make_optimizer("sgd", learning_rate=1e-2)
+    return train_lib.build_sharded_train(
+        model, opt, mesh, lr.DEFAULT_RULES,
+        global_batch_size=batch, seq_len=seq,
+        grad_accum=grad_accum, reduce_quant=reduce_quant, zero1=zero1,
+    )
+
+
+def _digest(train, state) -> str:
+    return state_digest.format_digest(
+        state_digest.build_digest_fn(train)(state)
+    )
+
+
+def _needs_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+
+
+# -- digest: determinism, layout-independence, sensitivity --------------------
+
+
+def test_digest_deterministic_and_layout_independent():
+    """Identical state => identical digest, including ACROSS shardings:
+    the replicated and zero1 builds init to bitwise-equal state (see
+    test_zero1.py's rationale), and the uint32 byte-sum fold is exact
+    integer arithmetic, so the layout cannot perturb the value."""
+    _needs_mesh()
+    full = _build()
+    z = _build(zero1=True)
+    d_full = _digest(full, full.init(jax.random.PRNGKey(0)))
+    d_z = _digest(z, z.init(jax.random.PRNGKey(0)))
+    assert d_full == d_z
+    # Re-digesting the same state is stable.
+    assert d_full == _digest(full, full.init(jax.random.PRNGKey(0)))
+    assert len(d_full) == 8 and int(d_full, 16) >= 0
+
+
+@pytest.mark.parametrize(
+    "zero1,grad_accum,reduce_quant",
+    [
+        (False, 1, "none"),
+        (True, 1, "none"),
+        (False, 4, "none"),
+        (True, 4, "int8"),
+    ],
+)
+def test_flip_is_exactly_one_outlier_under_every_build(
+    zero1, grad_accum, reduce_quant
+):
+    """Under every build shape, post-step replicas digest identically, a
+    single ``sdc.flip`` makes exactly ONE outlier, and the flip is
+    deterministic: rerunning with the same coordinates reproduces the
+    same corrupted digest."""
+    _needs_mesh()
+    train = _build(
+        zero1=zero1, grad_accum=grad_accum, reduce_quant=reduce_quant
+    )
+    state = train.init(jax.random.PRNGKey(0))
+    batch = train_lib.shard_batch(_make_batch(), train)
+    state, _ = train.step(state, batch)
+    clean = _digest(train, state)
+
+    flipped = state_digest.flip_mantissa_bit(
+        state, bit=10, leaf_index=1, flat_index=3
+    )
+    corrupt = _digest(train, flipped)
+    assert corrupt != clean
+    # Determinism with the same fault coordinates (what a seeded plan
+    # replays): the corrupted digest is reproducible bit for bit.
+    again = state_digest.flip_mantissa_bit(
+        state, bit=10, leaf_index=1, flat_index=3
+    )
+    assert _digest(train, again) == corrupt
+    # An XOR flip is an involution: flipping the same bit back restores
+    # the clean digest exactly.
+    restored = state_digest.flip_mantissa_bit(
+        flipped, bit=10, leaf_index=1, flat_index=3
+    )
+    assert _digest(train, restored) == clean
+
+    # Three replicas vote: the flipped one is the single outlier.
+    sm = SpeedMonitor()
+    for node, digest in enumerate([clean, clean, corrupt]):
+        sm.record_digest(node, step=16, digest=digest)
+    sm.record_digest(0, step=32, digest=clean)  # watermark finalizes 16
+    ledger = sm.sdc_ledger()
+    assert ledger["checks"] == 1 and ledger["mismatches"] == 1
+    assert ledger["streaks"] == {2: 1}
+
+
+def test_flip_fires_through_the_fault_seam():
+    """``sdc.flip`` is a registered Faultline seam: a plan arms it and a
+    seeded run fires it at the same hit every rerun."""
+    assert "sdc.flip" in faults.KNOWN_SEAMS
+    for _ in range(2):
+        plan = faults.configure("sdc.flip:error@2", seed=11)
+        try:
+            faults.fire("sdc.flip", step=1)  # hit 1: armed for hit 2 only
+            with pytest.raises(faults.FaultInjected) as e:
+                faults.fire("sdc.flip", step=2)
+            assert e.value.seam == "sdc.flip" and e.value.hit == 2
+            faults.fire("sdc.flip", step=3)  # one-shot: no further fires
+            assert plan.fired == [("sdc.flip", "error", 2)]
+        finally:
+            faults.configure("")
+
+
+def test_digest_no_retrace_at_check_cadence():
+    """The digest program compiles once; steps interleaved with digest
+    calls at the check cadence trigger ZERO fresh traces of either."""
+    _needs_mesh()
+    train = _build()
+    state = train.init(jax.random.PRNGKey(0))
+    digest_fn = state_digest.build_digest_fn(train)
+
+    def one_step(state, seed):
+        b = train_lib.shard_batch(
+            _make_batch(seed=seed), train
+        )
+        state, _ = train.step(state, b)
+        return state
+
+    state = one_step(state, 0)       # pays the single step compilation
+    digest_fn(state).block_until_ready()  # pays the digest compilation
+    with trace_asserts.assert_no_retrace("train_step", "state_digest"):
+        seen = set()
+        for seed in (1, 2, 3):
+            state = one_step(state, seed)
+            seen.add(state_digest.format_digest(digest_fn(state)))
+    assert len(seen) == 3  # the state (and digest) moved every step
+
+
+# -- vote: the speed monitor ledger -------------------------------------------
+
+
+def test_vote_watermark_is_per_node():
+    sm = SpeedMonitor()
+    sm.record_digest(0, 16, "aa", check_every=16)
+    sm.record_digest(1, 16, "aa")
+    # Nothing newer yet: step 16 is still pending.
+    assert sm.sdc_ledger()["checks"] == 0
+    # Only node 0 moving past 16 must NOT finalize it: node 1's replica
+    # may run a full report cadence behind (restarts skew replicas by
+    # minutes), and a global watermark would drop its vote.
+    sm.record_digest(0, 32, "bb")
+    assert sm.sdc_ledger()["checks"] == 0
+    sm.record_digest(1, 32, "bb")
+    ledger = sm.sdc_ledger()
+    assert ledger["checks"] == 1 and ledger["mismatches"] == 0
+    assert ledger["streaks"] == {} and ledger["check_every"] == 16
+
+
+def test_vote_stale_reporter_does_not_stall_the_pipeline():
+    sm = SpeedMonitor()
+    # Node 1 votes once and vanishes (crash without quarantine); node 0
+    # keeps checking.  Four check intervals past the fastest reporter,
+    # stale steps force-finalize so detection never deadlocks.
+    sm.record_digest(0, 16, "aa", check_every=16)
+    sm.record_digest(1, 16, "aa")
+    for step in (32, 48, 64, 80):
+        sm.record_digest(0, step, "aa")
+    assert sm.sdc_ledger()["checks"] == 0
+    sm.record_digest(0, 96, "aa")  # 16 is now > 4 checks stale
+    assert sm.sdc_ledger()["checks"] == 1
+
+
+def test_vote_single_report_step_dropped():
+    sm = SpeedMonitor()
+    sm.record_digest(0, 16, "aa")
+    sm.record_digest(0, 32, "aa")  # finalizes 16 with one vote: no info
+    assert sm.sdc_ledger()["checks"] == 0
+
+
+def test_vote_streak_accumulates_and_resets():
+    sm = SpeedMonitor()
+    # Two consecutive checks with node 2 in the minority.
+    for step, bad in ((16, "xx"), (32, "yy")):
+        for node in (0, 1):
+            sm.record_digest(node, step, f"good{step}")
+        sm.record_digest(2, step, bad)
+    sm.record_digest(0, 48, "good48")
+    assert sm.sdc_ledger()["streaks"] == {2: 2}
+    assert sm.sdc_ledger()["mismatches"] == 2
+    # A clean check resets the streak: corruption must be persistent.
+    for node in (0, 1, 2):
+        sm.record_digest(node, 48, "good48")
+    sm.record_digest(0, 64, "good64")
+    assert sm.sdc_ledger()["streaks"] == {}
+
+
+def test_vote_two_way_tie_trusts_neither():
+    sm = SpeedMonitor()
+    sm.record_digest(0, 16, "aa")
+    sm.record_digest(1, 16, "bb")
+    sm.record_digest(0, 32, "aa")
+    ledger = sm.sdc_ledger()
+    # A 1-1 split has no majority to trust: booked as a check, not a
+    # mismatch, and nobody's streak moves.
+    assert ledger["checks"] == 1 and ledger["mismatches"] == 0
+    assert ledger["streaks"] == {}
+
+
+def test_quarantine_clears_ledger_state():
+    sm = SpeedMonitor()
+    for node in (0, 1):
+        sm.record_digest(node, 16, "good")
+    sm.record_digest(2, 16, "bad")
+    sm.record_digest(0, 32, "good")
+    sm.record_digest(2, 32, "bad2")  # pending vote from the corrupt node
+    sm.record_sdc_quarantine(2)
+    ledger = sm.sdc_ledger()
+    assert ledger["quarantines"] == 1 and ledger["streaks"] == {}
+    # The quarantined node's pending vote is gone: once nodes 0/1 finalize
+    # step 32 it cannot re-enter the tally.
+    sm.record_digest(1, 32, "good")
+    sm.record_digest(0, 48, "good")
+    assert sm.sdc_ledger()["mismatches"] == 1  # still just the step-16 one
+
+
+def test_digest_report_routes_through_servicer():
+    sm = SpeedMonitor()
+    servicer = MasterServicer(speed_monitor=sm)
+    for node in (0, 1):
+        env = msg.Envelope(
+            node_id=node,
+            payload=msg.DigestReport(node, 16, "cafe0123", check_every=16),
+        )
+        assert servicer.report(env).success
+    for node in (0, 1):
+        servicer.report(msg.Envelope(
+            node_id=node, payload=msg.DigestReport(node, 32, "cafe0123"),
+        ))
+    assert sm.sdc_ledger()["checks"] == 1
+
+
+# -- decide: operator thresholds and the master's quarantine path -------------
+
+
+def _ctx(sm):
+    return DiagnosisContext(
+        speed_monitor=sm, metrics=None, node_manager=None, timeline=None,
+    )
+
+
+def _feed_minority(sm, steps, bad_node=2, nodes=3):
+    for step in steps:
+        for node in range(nodes):
+            digest = f"bad{step}" if node == bad_node else f"good{step}"
+            sm.record_digest(node, step, digest)
+    sm.record_digest(0, max(steps) + 16, "next")
+
+
+def test_operator_transient_mismatch_asks_for_confirm_probe():
+    sm = SpeedMonitor()
+    _feed_minority(sm, [16])
+    actions = SDCVoteOperator().observe(_ctx(sm))
+    assert [a.action for a in actions] == [ActionType.REPORT]
+    assert "confirm probe" in actions[0].reason
+    assert actions[0].node_id == 2
+
+
+def test_operator_persistent_minority_quarantines():
+    sm = SpeedMonitor()
+    _feed_minority(sm, [16, 32])
+    op = SDCVoteOperator()
+    actions = op.observe(_ctx(sm))
+    assert [a.action for a in actions] == [ActionType.QUARANTINE]
+    assert actions[0].node_id == 2 and actions[0].severity == 4
+    assert "minority" in actions[0].reason
+
+
+def test_operator_latch_quiets_stale_mismatches():
+    sm = SpeedMonitor()
+    _feed_minority(sm, [16])
+    op = SDCVoteOperator()
+    assert op.observe(_ctx(sm))          # fresh: confirm REPORT
+    assert op.observe(_ctx(sm)) == []    # same count: consumed, silent
+
+
+def test_operator_registered_in_default_chain():
+    assert any(
+        isinstance(op, SDCVoteOperator) for op in InferenceChain().operators
+    )
+
+
+def test_master_quarantine_blacklists_bans_and_replaces():
+    """The full QUARANTINE execution: node blacklisted (never relaunched),
+    banned from rendezvous re-join, replacement launched at a fresh id
+    with the target unchanged, ledger bumped."""
+    client = FakeTpuVmClient()
+    launcher = CloudNodeLauncher(client, job_name="job")
+    master = JobMaster(num_nodes=2, launcher=launcher, auto_scale=True,
+                       heartbeat_timeout=3600.0)
+    try:
+        nm = master.node_manager
+        master.bootstrap_nodes()
+        deadline = time.monotonic() + 5.0
+        while len(client.create_calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        for n in range(2):
+            nm.report_event(n, "started")
+        elastic = master.rdzv_managers["elastic-training"]
+        for n in range(2):
+            elastic.join_rendezvous(n, 1)
+
+        master._quarantine_node(1, "digest minority x2")
+
+        assert nm.is_quarantined(1)
+        assert nm.quarantined() == {1: "digest minority x2"}
+        assert not nm.relaunchable(1)
+        assert not nm.launch_node(1)          # blacklist sticks
+        assert not nm.force_relaunch(1)
+        assert nm.statuses()[1] == NodeStatus.FAILED.value
+        # Rendezvous ban: a re-join attempt is refused (no waiting entry).
+        round_before = elastic._rdzv_round
+        elastic.join_rendezvous(1, 1)
+        assert 1 not in elastic._alive_nodes
+        assert elastic._rdzv_round >= round_before
+        # Replacement minted at a fresh id, target unchanged.
+        deadline = time.monotonic() + 5.0
+        while (
+            "job-worker-2" not in client.create_calls
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert "job-worker-2" in client.create_calls
+        assert master.auto_scaler.target == 2
+        assert master.speed_monitor.sdc_ledger()["quarantines"] == 1
+        # The snapshot carries the verdict for the state store.
+        snap = nm.snapshot()[1]
+        assert snap["quarantined"] and "minority" in snap["quarantine_reason"]
+    finally:
+        master.stop()
+        launcher.shutdown()
+
+
+def test_quarantine_does_not_wedge_job_completion():
+    nm = NodeManager(num_nodes=2)
+    nm.report_event(0, "started")
+    nm.report_event(1, "started")
+    nm.quarantine(1, "sdc")
+    nm.report_event(0, "succeeded")
+    # The quarantined node can never succeed; the job must still complete.
+    assert nm.all_succeeded()
+
+
+def test_quarantine_survives_master_restart(tmp_path):
+    """Satellite: the state store round-trips the blacklist — a restarted
+    master cannot re-admit a quarantined node."""
+    path = str(tmp_path / "master_state.json")
+    master = JobMaster(num_nodes=2, min_nodes=1, state_path=path)
+    try:
+        master.node_manager.ensure_node(1)
+        master._quarantine_node(1, "digest minority x2")
+        master._state_store.save(master)
+    finally:
+        master.stop()
+
+    fresh = JobMaster(num_nodes=2, min_nodes=1, state_path=path)
+    try:
+        fresh.start()
+        assert fresh.node_manager.is_quarantined(1)
+        assert fresh.node_manager.quarantined()[1] == "digest minority x2"
+        assert not fresh.node_manager.relaunchable(1)
+        elastic = fresh.rdzv_managers["elastic-training"]
+        elastic.join_rendezvous(1, 1)  # refused: the ban was restored
+        assert 1 not in elastic._alive_nodes
+    finally:
+        fresh.stop()
+
+
+# -- trainer: cadence, shipping, and the injected flip ------------------------
+
+
+class _DigestClient:
+    def __init__(self):
+        self.digests = []
+
+    def report_digest(self, step, digest, check_every=0):
+        self.digests.append((step, digest, check_every))
+
+    def report_step(self, step, tokens=0, loss=0.0, anomalies=()):
+        pass
+
+    def report_telemetry(self, events, dropped=0):
+        pass
+
+    def report_event(self, event, detail=""):
+        pass
+
+
+def _tiny_trainer(client, sdc_check_every=2, fault_plan=""):
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    faults.configure(fault_plan, seed=5)
+    cfg = gpt2_config(
+        "124m", num_layers=1, d_model=64, num_heads=2,
+        vocab_size=256, max_seq_len=16,
+    )
+    return ElasticTrainer(
+        cfg,
+        TrainerConfig(
+            global_batch_size=16, seq_len=16, optimizer="sgd",
+            learning_rate=1e-2, report_every=4,
+            sdc_check_every=sdc_check_every,
+        ),
+        client=client,
+        parallel=ParallelConfig(data=2, fsdp=4),
+    )
+
+
+def _run_trainer(client, steps=4, **kw):
+    trainer = _tiny_trainer(client, **kw)
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(steps):
+            t = rng.integers(0, 256, size=(16, 17), dtype=np.int32)
+            trainer.train_step({"inputs": t[:, :-1], "targets": t[:, 1:]})
+        trainer._report(trainer._last_metrics)
+        return trainer
+    finally:
+        trainer.close()
+        faults.configure("")
+
+
+def test_trainer_ships_digests_on_report_cadence():
+    _needs_mesh()
+    client = _DigestClient()
+    trainer = _run_trainer(client, steps=4, sdc_check_every=2)
+    assert [d[0] for d in client.digests] == [2, 4]
+    assert all(len(d[1]) == 8 for d in client.digests)
+    assert all(d[2] == 2 for d in client.digests)
+    assert trainer._pending_digests == []  # the report drained them
+    # Disabled path builds nothing.
+    off = _DigestClient()
+    t2 = _run_trainer(off, steps=2, sdc_check_every=0)
+    assert off.digests == [] and t2._digest_fn is None
+
+
+def test_trainer_injected_flip_diverges_digest():
+    """Same model, same batches: the replica whose plan fires ``sdc.flip``
+    reports a different digest at the flip step — the drill's detection
+    signal, reproduced in-process."""
+    _needs_mesh()
+    clean = _run_trainer(_DigestClient(), steps=4, sdc_check_every=2)
+    del clean
+    clean_digests = _run_trainer(
+        _DigestClient(), steps=4, sdc_check_every=2
+    )
+    client_a = _DigestClient()
+    _run_trainer(client_a, steps=4, sdc_check_every=2)
+    client_b = _DigestClient()
+    _run_trainer(
+        client_b, steps=4, sdc_check_every=2,
+        fault_plan="sdc.flip:error@1",
+    )
+    del clean_digests
+    # Uninjected reruns agree with each other...
+    assert client_a.digests, "no digests shipped"
+    # ...and the injected run diverges from the first check onward (the
+    # flip persists in the live state, like real corruption).
+    assert [d[0] for d in client_b.digests] == [d[0] for d in client_a.digests]
+    assert client_b.digests[0][1] != client_a.digests[0][1]
+
+
+def test_trainer_check_does_not_retrace():
+    _needs_mesh()
+    client = _DigestClient()
+    trainer = _tiny_trainer(client, sdc_check_every=2)
+    try:
+        rng = np.random.default_rng(0)
+
+        def step():
+            t = rng.integers(0, 256, size=(16, 17), dtype=np.int32)
+            trainer.train_step({"inputs": t[:, :-1], "targets": t[:, 1:]})
+
+        step()
+        step()  # first check pays the single digest compilation
+        with trace_asserts.assert_no_retrace("train_step", "state_digest"):
+            for _ in range(4):
+                step()
+        assert [s for s, _ in trainer._pending_digests] == [2, 4, 6]
+    finally:
+        trainer.close()
+        faults.configure("")

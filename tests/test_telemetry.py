@@ -90,6 +90,37 @@ def test_event_t_mono_backdates():
     assert first[2] < time.time() - 2.0  # backdated, not "now"
 
 
+def test_reserved_attrs_rejected_with_clear_error():
+    """Regression: attrs named after the span()/event() parameters used to
+    surface as an opaque ``TypeError: got multiple values for argument`` —
+    or, for ``duration_s`` arriving through a **dict, silently rebind the
+    timing channel.  They are now rejected with a self-describing error."""
+    r = _recorder()
+    # `name` no longer binds the positional parameter (positional-only):
+    # it reaches attrs and is rejected there with the reserved-name error.
+    with pytest.raises(ValueError, match="reserved"):
+        r.event("probe", **{"name": "matmul"})
+    with pytest.raises(ValueError, match="reserved"):
+        r.span("probe", **{"t_mono": 1.0})
+    with pytest.raises(ValueError, match="reserved"):
+        telemetry.event("probe", **{"name": "matmul", "host": "w0"})
+    # A numeric duration_s kwarg IS the documented timing parameter (its
+    # binding is indistinguishable from intent), but a non-numeric one is
+    # an attr misrouted into the timing channel.
+    with pytest.raises(TypeError, match="timing parameter"):
+        r.event("probe", duration_s="slow")
+    # The rejection fires even while disabled — a latent collision must not
+    # hide until telemetry is switched on.
+    off = _recorder(enabled=False)
+    with pytest.raises(ValueError, match="reserved"):
+        off.event("probe", **{"name": "x"})
+    # Nothing landed in the ring, and legit reserved-free attrs still work.
+    assert r.drain() == []
+    r.event("probe", probe_duration_s=2.0, kind="block")
+    (event,) = r.drain()
+    assert event[4]["probe_duration_s"] == 2.0
+
+
 def test_wall_clock_anchor():
     r = _recorder()
     r.event("tick")

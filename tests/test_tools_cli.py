@@ -55,6 +55,9 @@ def test_goodput_bench_help(cpu_child_env):
     assert "--fault-plan" in out.stdout and "--fault-seed" in out.stdout
     assert "--resize-drill" in out.stdout
     assert "--drill-preempt-hit" in out.stdout
+    assert "--sdc-drill" in out.stdout
+    assert "--sdc-check-every" in out.stdout
+    assert "--sdc-flip-hit" in out.stdout
 
 
 def test_tracelint_json_smoke(tmp_path, cpu_child_env):

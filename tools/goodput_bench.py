@@ -9,6 +9,8 @@ on a schedule, and report the master SpeedMonitor's goodput ledger.
 
     python tools/goodput_bench.py --steps 400 --kill-every 60 --out GOODPUT.json
     python tools/goodput_bench.py --resize-drill --steps 120 --out DRILL.json
+    python tools/goodput_bench.py --sdc-drill --steps 60 --step-sleep 0.2 \\
+        --sdc-check-every 8 --out SDC.json
 
 Runs on CPU (JAX_PLATFORMS=cpu) by default so it exercises the control
 plane, not the chip.
@@ -208,6 +210,245 @@ def run_resize_drill(args) -> int:
     return 0 if result["detail"]["completed"] else 1
 
 
+def run_sdc_drill(args) -> int:
+    """Deterministic silent-data-corruption drill (3 hosts, 1 bitflip).
+
+    Node 2's fault plan scripts one ``sdc.flip`` at a fixed digest check,
+    so a single mantissa bit of its live train state flips at the same
+    point every run.  The corrupted replica's state digest then diverges
+    from the other two at every later check; the master's cross-replica
+    vote (SpeedMonitor digest ledger -> SDCVoteOperator) pins the 2-vs-1
+    minority, and after a persistent streak QUARANTINEs the host:
+    blacklist + rendezvous ban + replacement request + world restart onto
+    the last checkpoint.  The drill books detection latency in steps and
+    verifies the vote fingered the right host, that post-restore digests
+    are unanimous, and that the recovered loss trajectory tracks an
+    uninjected reference run.
+
+    ``--lockstep-data`` is load-bearing: with ``DLROVER_TPU_SKIP_JAX_INIT``
+    each node is its own data replica, and the digests only agree when the
+    replicas consume identical batches.
+    """
+    import shutil
+
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.master.job_master import JobMaster
+
+    os.makedirs(args.workdir, exist_ok=True)
+    victim_id = 2
+    flip_step = args.sdc_flip_hit * args.sdc_check_every
+    drill_plan = f"sdc.flip:error@{args.sdc_flip_hit}"
+    faults.parse_plan(drill_plan)
+
+    def train_cmd(port: int, nnodes: str, node_id: int, ckpt: str):
+        return [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--master", f"localhost:{port}",
+            "--nnodes", nnodes, "--node-id", str(node_id),
+            "--max-restarts", "1000",
+            "--monitor-interval", "0.5",
+            "--heartbeat-interval", "2",
+            "--save-at-breakpoint",
+            "--checkpoint-dir", ckpt,
+            "--", sys.executable,
+            os.path.join(REPO, "examples", "train_lm.py"),
+            "--steps", str(args.steps), "--ckpt-every", "10",
+            "--checkpoint-dir", ckpt,
+            "--layers", "1", "--d-model", "64", "--heads", "2",
+            "--seq-len", "64", "--batch-size", "4",
+            "--step-sleep", str(args.step_sleep),
+            "--sdc-check-every", str(args.sdc_check_every),
+            "--lockstep-data",
+        ]
+
+    # -- phase 1: chaos run (3 nodes, node 2 flips one bit) -------------------
+    ckpt = os.path.join(args.workdir, "ckpt_sdc")
+    shutil.rmtree(ckpt, ignore_errors=True)
+    master = JobMaster(
+        num_nodes=3, min_nodes=2,
+        heartbeat_timeout=8.0, max_relaunches=10**6,
+    )
+    master.CONTROL_LOOP_INTERVAL = 2.0
+    port = master.start()
+    base_env = _bench_env(args)
+    base_env["DLROVER_TPU_SKIP_JAX_INIT"] = "1"
+    base_env["DLROVER_TPU_JOB"] = f"sdc{os.getpid()}"
+
+    def spawn(node_id: int, plan: str = ""):
+        env = dict(base_env)
+        if plan:
+            env[faults.ENV_PLAN] = plan
+            env[faults.ENV_SEED] = str(args.fault_seed)
+        return subprocess.Popen(
+            train_cmd(port, "2:3", node_id, ckpt),
+            env=env, start_new_session=True,
+        )
+
+    t_start = time.monotonic()
+    procs = {i: spawn(i) for i in range(victim_id)}
+    procs[victim_id] = spawn(victim_id, drill_plan)
+    quarantine_step = -1
+    voted_node = -1
+    t_first_mismatch = None
+    t_quarantine = None
+    mismatches_at_quarantine = -1
+    survivors_done = set()
+    failed = False
+    deadline = t_start + args.steps * max(args.step_sleep, 0.1) * 8 + 900
+    while time.monotonic() < deadline:
+        sm = master.speed_monitor
+        ledger = sm.sdc_ledger()
+        if t_first_mismatch is None and ledger["mismatches"] > 0:
+            t_first_mismatch = time.monotonic()
+            print(f"[sdc] first digest mismatch at step {sm.global_step} "
+                  f"(streaks {ledger['streaks']})", flush=True)
+        quarantined = master.node_manager.quarantined()
+        if t_quarantine is None and quarantined:
+            t_quarantine = time.monotonic()
+            quarantine_step = sm.global_step
+            voted_node = next(iter(quarantined))
+            mismatches_at_quarantine = ledger["mismatches"]
+            print(f"[sdc] node {voted_node} quarantined at step "
+                  f"{quarantine_step}: {quarantined[voted_node]}",
+                  flush=True)
+            # The banned host is gone for good — like a real corrupting
+            # chip, it never re-joins; the drill reaps its process group.
+            proc = procs.pop(victim_id, None)
+            if proc is not None and proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+        for node_id in list(procs):
+            rc = procs[node_id].poll()
+            if rc is None:
+                continue
+            if rc == 0:
+                survivors_done.add(node_id)
+                del procs[node_id]
+            elif node_id == victim_id:
+                del procs[node_id]  # banned victim's exit code is moot
+            else:
+                failed = True
+                print(f"[sdc] survivor {node_id} exited rc {rc}",
+                      flush=True)
+                del procs[node_id]
+        if failed or len(survivors_done) >= 2:
+            break
+        time.sleep(0.5)
+    for proc in procs.values():
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+
+    sm = master.speed_monitor
+    ledger = sm.sdc_ledger()
+    chaos_losses = sm.recent_losses(5)
+    completed = len(survivors_done) >= 2 and sm.global_step >= args.steps
+    detect_steps = (
+        quarantine_step - flip_step if quarantine_step >= 0 else -1
+    )
+    # Post-restore unanimity: once the corrupting host is out, every later
+    # finalized vote must agree — zero mismatches after the quarantine.
+    post_restore_mismatches = (
+        ledger["mismatches"] - mismatches_at_quarantine
+        if mismatches_at_quarantine >= 0 else -1
+    )
+    master.stop()
+
+    # -- phase 2: uninjected reference run (loss-trajectory parity) -----------
+    # Bitwise parity is out of reach (the restart rewinds the lockstep
+    # sample stream), so the drill checks the recovered trajectory's tail
+    # lands on the clean run's: same toy problem, same step count.
+    ckpt_ref = os.path.join(args.workdir, "ckpt_ref")
+    shutil.rmtree(ckpt_ref, ignore_errors=True)
+    ref_master = JobMaster(
+        num_nodes=1, heartbeat_timeout=8.0, max_relaunches=10**6
+    )
+    ref_master.CONTROL_LOOP_INTERVAL = 2.0
+    ref_port = ref_master.start()
+    ref_env = _bench_env(args)
+    ref_env["DLROVER_TPU_SKIP_JAX_INIT"] = "1"
+    ref_env["DLROVER_TPU_JOB"] = f"sdcref{os.getpid()}"
+    ref = subprocess.Popen(
+        train_cmd(ref_port, "1", 0, ckpt_ref),
+        env=ref_env, start_new_session=True,
+    )
+    ref_deadline = time.monotonic() + args.steps * max(
+        args.step_sleep, 0.1
+    ) * 6 + 600
+    ref_ok = False
+    while time.monotonic() < ref_deadline:
+        rc = ref.poll()
+        if rc is not None:
+            ref_ok = rc == 0
+            break
+        time.sleep(0.5)
+    if ref.poll() is None:
+        try:
+            os.killpg(os.getpgid(ref.pid), signal.SIGKILL)
+        except (ProcessLookupError, OSError):
+            pass
+    ref_losses = ref_master.speed_monitor.recent_losses(5)
+    ref_master.stop()
+
+    def _mean(samples):
+        return (
+            sum(v for _, v in samples) / len(samples) if samples else -1.0
+        )
+
+    loss_chaos, loss_ref = _mean(chaos_losses), _mean(ref_losses)
+    loss_rel_err = (
+        abs(loss_chaos - loss_ref) / max(abs(loss_ref), 1e-9)
+        if loss_chaos >= 0 and loss_ref >= 0 else -1.0
+    )
+    ok = (
+        completed
+        and ref_ok
+        and voted_node == victim_id
+        and detect_steps >= 0
+        and post_restore_mismatches == 0
+        and 0.0 <= loss_rel_err < 0.25
+    )
+    result = {
+        "metric": "SDC drill (bitflip -> vote -> quarantine -> restore)",
+        "value": detect_steps,
+        "unit": "steps from flip to quarantine",
+        "detail": {
+            "ok": ok,
+            "completed": completed,
+            "final_step": sm.global_step,
+            "target_steps": args.steps,
+            "flip_step": flip_step,
+            "flipped_node": victim_id,
+            "voted_node": voted_node,
+            "quarantine_step": quarantine_step,
+            "detect_steps": detect_steps,
+            "detect_s": (
+                round(t_quarantine - t_first_mismatch, 2)
+                if t_quarantine and t_first_mismatch else -1.0
+            ),
+            "sdc_checks": ledger["checks"],
+            "sdc_mismatches": ledger["mismatches"],
+            "sdc_quarantines": ledger["quarantines"],
+            "post_restore_mismatches": post_restore_mismatches,
+            "loss_recovered": round(loss_chaos, 4),
+            "loss_reference": round(loss_ref, 4),
+            "loss_rel_err": round(loss_rel_err, 4),
+            "reference_completed": ref_ok,
+            "check_every": args.sdc_check_every,
+            "fault_plan": drill_plan,
+            "fault_seed": args.fault_seed,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=600)
@@ -243,9 +484,26 @@ def main() -> int:
                     help="preempt.notice seam hit at which node 1's notice "
                          "fires (the monitor probes ~1/s, so this is "
                          "roughly seconds into the run)")
+    ap.add_argument("--sdc-drill", action="store_true",
+                    help="deterministic silent-data-corruption drill: 3 "
+                         "nodes train in lockstep, node 2's sdc.flip seam "
+                         "flips one mantissa bit of its live state, the "
+                         "cross-replica digest vote pins the 2-vs-1 "
+                         "minority and quarantines the host; reports "
+                         "detect_steps + post-restore loss parity vs an "
+                         "uninjected reference run")
+    ap.add_argument("--sdc-check-every", type=int, default=16,
+                    help="digest-check cadence handed to the trainers "
+                         "(--sdc-check-every of examples/train_lm.py)")
+    ap.add_argument("--sdc-flip-hit", type=int, default=1,
+                    help="sdc.flip seam hit at which the victim's bit "
+                         "flips (hit N = the N-th digest check, i.e. step "
+                         "N * sdc-check-every)")
     args = ap.parse_args()
     if args.resize_drill:
         return run_resize_drill(args)
+    if args.sdc_drill:
+        return run_sdc_drill(args)
 
     from dlrover_tpu.master.job_master import JobMaster
 

@@ -32,7 +32,7 @@ injected failures instead of blaming the job.
 Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``rpc.report``, ``rpc.get``, ``storage.write``, ``storage.read``,
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
-``preempt.notice``, ``rdzv.join``, ``sdc.flip``.
+``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``.
 """
 
 from __future__ import annotations
@@ -70,6 +70,10 @@ KNOWN_SEAMS = (
     # flipper) — modeling a chip that computes wrong numbers while every
     # liveness monitor stays green.
     "sdc.flip",
+    # Serving admission seam: fires on every ServingEngine.submit, under
+    # the engine's RetryPolicy (error kinds are retried with backoff;
+    # delay kinds stall admission — modeling a slow/flaky front door).
+    "serve.admit",
 )
 
 

@@ -46,6 +46,24 @@ class ScalePlan:
         return not self.launch and not self.delete
 
 
+@dataclasses.dataclass
+class ServeScalePolicy:
+    """Replica policy for the serving plane, driven by the serve ledger.
+
+    Scale OUT when the fleet's worst-replica p95 breaches the SLO or the
+    slot pools run hot (queued requests are about to wait); scale IN only
+    when BOTH latency and occupancy sit comfortably low — shrinking on
+    latency alone would thrash against a bursty arrival process.
+    ``min_qps`` ignores idle/startup ledgers whose quantiles carry no
+    signal.
+    """
+
+    slo_p95_s: float = 1.0
+    occupancy_high: float = 0.85
+    occupancy_low: float = 0.30
+    min_qps: float = 0.0
+
+
 class JobAutoScaler:
     def __init__(
         self,
@@ -59,6 +77,7 @@ class JobAutoScaler:
         retire_hook: Optional[Callable[[int], None]] = None,
         optimizer: Optional[RunningJobOptimizer] = None,
         optimize_interval_s: float = 300.0,
+        serve_policy: Optional[ServeScalePolicy] = None,
     ):
         self.node_manager = node_manager
         self.speed_monitor = speed_monitor
@@ -75,6 +94,8 @@ class JobAutoScaler:
         # None disables; the repair/target-tracking loop still runs.
         self.optimizer = optimizer
         self.optimize_interval_s = optimize_interval_s
+        # Latency/occupancy-driven serving replica policy: None disables.
+        self.serve_policy = serve_policy
         # First optimize only after a full interval of observations.
         self._last_optimize = time.monotonic()
         self._target = max_nodes
@@ -236,9 +257,45 @@ class JobAutoScaler:
             # let the job limp at a fraction of its proven speed forever.
             logger.warning("brain health: %s", plan.reason)
 
+    def observe_serving(self) -> None:
+        """Move the target from the serving ledger (the serving analogue
+        of ``observe_and_optimize``): p95-SLO breach or hot slot pools
+        scale out one node_unit; cold pools under half the SLO scale in.
+        ``set_target`` clamps/aligns; the ``decide`` loop actuates under
+        the usual cooldown."""
+        policy = self.serve_policy
+        if policy is None:
+            return
+        ledger = self.speed_monitor.serve_ledger()
+        if ledger["replicas"] < 1 or ledger["qps"] < policy.min_qps:
+            return
+        target = self.target
+        p95 = ledger["p95_s"]
+        occupancy = ledger["occupancy"]
+        if p95 > policy.slo_p95_s or occupancy > policy.occupancy_high:
+            self.set_target(
+                target + self.node_unit,
+                reason=(
+                    f"serve: p95 {p95:.3f}s (slo {policy.slo_p95_s}s), "
+                    f"occupancy {occupancy:.2f}"
+                ),
+            )
+        elif (
+            p95 < 0.5 * policy.slo_p95_s
+            and occupancy < policy.occupancy_low
+        ):
+            self.set_target(
+                target - self.node_unit,
+                reason=(
+                    f"serve: idle (p95 {p95:.3f}s, occupancy "
+                    f"{occupancy:.2f})"
+                ),
+            )
+
     def step(self) -> Optional[ScalePlan]:
         """One control-loop tick: decide and actuate (cooldown-limited)."""
         self.observe_and_optimize()
+        self.observe_serving()
         now = time.monotonic()
         if now - self._last_scale < self.cooldown_s:
             return None

@@ -313,6 +313,17 @@ class MasterServicer:
                         str(attrs.get("kind", "")),
                         float(duration_s or 0.0),
                     )
+                elif name == "serve" and isinstance(attrs, dict):
+                    # Serving-replica stats snapshot: feeds the serve
+                    # ledger behind dlrover_serve_* and the auto-scaler's
+                    # latency/occupancy replica policy.
+                    try:
+                        self.speed_monitor.record_serve(node, **attrs)
+                    except (TypeError, ValueError):
+                        logger.warning(
+                            "unparseable serve event from %d: %r",
+                            node, attrs,
+                        )
         if p.dropped:
             logger.warning(
                 "node %d telemetry ring overwrote %d events before this "

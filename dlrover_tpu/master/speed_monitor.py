@@ -70,6 +70,12 @@ class SpeedMonitor:
         # post-restore parity check compares the recovered trajectory's
         # tail against an uninjected reference run.
         self._recent_losses: Deque[Tuple[int, float]] = deque(maxlen=512)
+        # Serving ledger: latest snapshot per serving replica from its
+        # "serve" telemetry events (QPS, latency quantiles, slot
+        # occupancy) — the auto-scaler's replica policy and the
+        # ``dlrover_serve_*`` gauges read the aggregate.
+        self._serve_stats: Dict[int, Dict[str, float]] = {}
+        self._serve_events = 0
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -144,6 +150,52 @@ class SpeedMonitor:
             self._fault_lost_s += max(0.0, lost_s)
             key = f"{seam}:{kind}" if kind else seam
             self._faults_by_seam[key] = self._faults_by_seam.get(key, 0) + 1
+
+    def record_serve(
+        self,
+        node_id: int = 0,
+        *,
+        qps: float = 0.0,
+        p50_s: float = 0.0,
+        p95_s: float = 0.0,
+        occupancy: float = 0.0,
+        slots: float = 0.0,
+        requests: float = 0.0,
+        tokens: float = 0.0,
+        **_ignored,
+    ):
+        """A serving replica's stats snapshot (its ``serve`` telemetry
+        event).  Newest-wins per replica; unknown attrs are ignored so
+        engines can grow the event without breaking older masters."""
+        with self._lock:
+            self._serve_events += 1
+            self._serve_stats[node_id] = {
+                "qps": float(qps), "p50_s": float(p50_s),
+                "p95_s": float(p95_s), "occupancy": float(occupancy),
+                "slots": float(slots), "requests": float(requests),
+                "tokens": float(tokens),
+            }
+
+    def serve_ledger(self) -> Dict[str, float]:
+        """Fleet aggregate: QPS/requests/tokens/slots sum across replicas,
+        latency quantiles take the WORST replica (an SLO is breached when
+        any replica breaches it), occupancy averages."""
+        with self._lock:
+            stats = list(self._serve_stats.values())
+            n = len(stats)
+            return {
+                "serve_events": float(self._serve_events),
+                "replicas": float(n),
+                "qps": sum(s["qps"] for s in stats),
+                "p50_s": max((s["p50_s"] for s in stats), default=0.0),
+                "p95_s": max((s["p95_s"] for s in stats), default=0.0),
+                "occupancy": (
+                    sum(s["occupancy"] for s in stats) / n if n else 0.0
+                ),
+                "slots": sum(s["slots"] for s in stats),
+                "requests": sum(s["requests"] for s in stats),
+                "tokens": sum(s["tokens"] for s in stats),
+            }
 
     def fault_ledger(self) -> Dict[str, object]:
         with self._lock:

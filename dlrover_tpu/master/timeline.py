@@ -247,6 +247,26 @@ class JobTimeline:
                   resize["resize_s_total"] + resize["resize_open_s"],
                   "wall seconds between a resize notice and the next "
                   "step advance (open window included)")
+            serve = speed_monitor.serve_ledger()
+            gauge("dlrover_serve_qps", serve["qps"],
+                  "completed serving requests/s, summed over replicas")
+            lines.append(
+                "# HELP dlrover_serve_latency_seconds request latency "
+                "quantiles (worst replica)"
+            )
+            lines.append("# TYPE dlrover_serve_latency_seconds gauge")
+            gauge("dlrover_serve_latency_seconds", serve["p50_s"],
+                  labels='{quantile="0.5"}')
+            gauge("dlrover_serve_latency_seconds", serve["p95_s"],
+                  labels='{quantile="0.95"}')
+            gauge("dlrover_serve_slot_occupancy", serve["occupancy"],
+                  "mean fraction of KV-cache slots live (0..1)")
+            gauge("dlrover_serve_requests_total", serve["requests"],
+                  "serving requests completed, summed over replicas")
+            gauge("dlrover_serve_tokens_total", serve["tokens"],
+                  "tokens generated by serving, summed over replicas")
+            gauge("dlrover_serve_replicas", serve["replicas"],
+                  "serving replicas that have reported stats")
             sdc = speed_monitor.sdc_ledger()
             gauge("dlrover_sdc_checks_total", sdc["checks"],
                   "cross-replica state-digest votes performed")

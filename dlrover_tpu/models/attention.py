@@ -260,15 +260,27 @@ class Attention(nn.Module):
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32),
             )
-            cur = index.value
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(self.dtype), (0, cur, 0, 0)
-            )
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(self.dtype), (0, cur, 0, 0)
-            )
-            index.value = cur + t
+            # Writes land at each row's OWN query positions (not a shared
+            # scalar cursor): row r's contiguous chunk of t tokens starts at
+            # positions[r, 0].  For the lockstep RL rollout every row shares
+            # one position so this degrades to the old single-cursor write;
+            # for the serving plane's slotted decode each slot sits at its
+            # own depth, and the per-row write is what lets one jitted step
+            # advance all of them.  cache_index is kept as a high-water
+            # cursor for introspection only — no write reads it.
             q_positions = jnp.broadcast_to(positions, (b, t))
+            row_start = q_positions[:, 0]
+
+            def write_row(buf, new, start):
+                return jax.lax.dynamic_update_slice(buf, new, (start, 0, 0))
+
+            cached_k.value = jax.vmap(write_row)(
+                cached_k.value, k.astype(self.dtype), row_start
+            )
+            cached_v.value = jax.vmap(write_row)(
+                cached_v.value, v.astype(self.dtype), row_start
+            )
+            index.value = jnp.max(row_start) + t
             out = cached_attention(
                 q, cached_k.value, cached_v.value, q_positions
             )

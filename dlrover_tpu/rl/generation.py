@@ -17,12 +17,14 @@ the single-token matmuls and the sampler together.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
+from dlrover_tpu.trainer import train_lib
 
 
 @dataclasses.dataclass
@@ -39,12 +41,20 @@ class GenerationBackend:
     the sampled tokens [B, N]).  ``prompts`` must be a fixed-width int32
     array (static prompt length; the engine re-jits per distinct shape,
     which a fixed rollout pipeline hits once).
+
+    ``prompt_buckets`` (opt-in) pads prompts to a fixed set of widths via
+    the serving bucketer, so rollout pipelines with *varying* prompt
+    lengths compile once per bucket instead of once per length.  The
+    returned prompt section is then the padded bucket width (pads are
+    causally inert — see ``serving/bucketing.py``); the generated tokens
+    are always the last ``max_new_tokens`` columns.
     """
 
     def __init__(
         self,
         config: TransformerConfig,
         sampling: Optional[SamplingParams] = None,
+        prompt_buckets: Optional[Sequence[int]] = None,
     ):
         self.sampling = sampling or SamplingParams()
         total = self.sampling.max_new_tokens
@@ -74,6 +84,21 @@ class GenerationBackend:
                 f"top_k {self.sampling.top_k} exceeds vocab_size "
                 f"{self.config.vocab_size}"
             )
+        self.prompt_buckets: Optional[Tuple[int, ...]] = None
+        if prompt_buckets is not None:
+            buckets = tuple(sorted(int(w) for w in prompt_buckets))
+            if not buckets or buckets[0] < 1:
+                raise ValueError(
+                    f"prompt_buckets must be positive widths, got "
+                    f"{prompt_buckets}"
+                )
+            if buckets[-1] + total > self.config.max_seq_len:
+                raise ValueError(
+                    f"largest bucket {buckets[-1]} + max_new_tokens "
+                    f"{total} exceeds max_seq_len "
+                    f"{self.config.max_seq_len}"
+                )
+            self.prompt_buckets = buckets
         self._generate = jax.jit(self._generate_impl)
 
     def _sample(self, logits: jax.Array, rng: jax.Array) -> jax.Array:
@@ -86,11 +111,15 @@ class GenerationBackend:
             return jnp.argmax(logits32, axis=-1)
         scaled = logits32 / jnp.maximum(s.temperature, 1e-6)
         if s.top_k:
-            kth = jnp.sort(scaled, axis=-1)[..., -s.top_k][..., None]
+            # The k-th largest via lax.top_k — O(V log k) and no [*, V]
+            # sorted intermediate, vs the old full-vocab jnp.sort.  Same
+            # threshold value, so the >= filter is bit-identical.
+            kth = jax.lax.top_k(scaled, s.top_k)[0][..., -1][..., None]
             scaled = jnp.where(scaled >= kth, scaled, -1e15)
         return jax.random.categorical(rng, scaled, axis=-1)
 
-    def _generate_impl(self, params, prompts, rng):
+    def _generate_impl(self, params, prompts, true_len, rng):
+        train_lib.TRACE_COUNTS["generate"] += 1
         b, prompt_len = prompts.shape
         n_new = self.sampling.max_new_tokens
         if prompt_len + n_new > self.config.max_seq_len:
@@ -111,7 +140,13 @@ class GenerationBackend:
         )
         cache = mutated["cache"]
         rng, step_rng = jax.random.split(rng)
-        first = self._sample(logits[:, -1], step_rng)
+        # The next-token logits sit at the last REAL position (== -1 when
+        # unbucketed; inside the pad region's left edge when bucketed).
+        # A traced gather, so every true_len shares one program.
+        last_logits = jax.lax.dynamic_slice_in_dim(
+            logits, true_len - 1, 1, axis=1
+        )[:, 0]
+        first = self._sample(last_logits, step_rng)
 
         def decode_step(carry, step_rng):
             cache, token, pos = carry
@@ -132,7 +167,7 @@ class GenerationBackend:
                 )[:, 0], nxt),
             )
 
-        pos0 = jnp.full((b,), prompt_len, jnp.int32)
+        pos0 = jnp.full((b,), true_len, jnp.int32)
         step_rngs = jax.random.split(rng, n_new - 1) if n_new > 1 else (
             jnp.zeros((0, 2), jnp.uint32)
         )
@@ -150,7 +185,7 @@ class GenerationBackend:
         # Logprob of the FIRST sampled token under the prefill logits;
         # later tokens' logprobs come out of the scan.
         logp0 = jax.nn.log_softmax(
-            logits[:, -1].astype(jnp.float32), axis=-1
+            last_logits.astype(jnp.float32), axis=-1
         )
         first_logp = jnp.take_along_axis(
             logp0, first[:, None], axis=-1
@@ -165,4 +200,14 @@ class GenerationBackend:
     def generate(
         self, params, prompts: jax.Array, rng: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:
-        return self._generate(params, prompts, rng)
+        true_len = prompts.shape[1]
+        if self.prompt_buckets is not None:
+            # Lazy import: serving's engine imports this module, so the
+            # bucketer must not be pulled in at module import time.
+            from dlrover_tpu.serving.bucketing import pad_to_bucket
+
+            prompts, true_len = pad_to_bucket(
+                np.asarray(prompts), self.prompt_buckets
+            )
+            prompts = jnp.asarray(prompts)
+        return self._generate(params, prompts, jnp.int32(true_len), rng)

@@ -144,3 +144,30 @@ def train_cache_key(
         global_batch_size, seq_len, ce_chunks, optimizer,
         grad_accum, accum_dtype, reduce_quant, zero1,
     ))
+
+
+def serve_cache_key(
+    model_config,
+    mesh_shape=(),
+    *,
+    slots: int,
+    buckets,
+    max_top_k: int = 0,
+) -> str:
+    """Name the serving program set by everything that shapes it.
+
+    The serving analogue of :func:`train_cache_key`: the model config,
+    the mesh axis sizes, the slot-pool size (decode batch shape), the
+    prefill bucket widths (one prefill program each), and the static
+    top-k ceiling (the ``lax.top_k`` width baked into the sampler).
+    Equal keys mean a rebuilt engine — an elastic replica restart, or a
+    second engine in-process — can reuse traced programs and AOT
+    executables wholesale.
+    """
+    fields = tuple(sorted(
+        (k, repr(v)) for k, v in vars(model_config).items()
+    ))
+    return repr((
+        "serve", type(model_config).__name__, fields, tuple(mesh_shape),
+        slots, tuple(buckets), max_top_k,
+    ))

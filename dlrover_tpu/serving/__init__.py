@@ -1,0 +1,18 @@
+"""Serving plane: continuous-batching decode on the training models.
+
+The second traffic class on the elastic substrate (ROADMAP item 3): the
+SAME param pytree that trains also serves, through the slotted/paged KV
+cache in :mod:`dlrover_tpu.serving.decode` and the host-side continuous
+batching scheduler in :mod:`dlrover_tpu.serving.engine`.
+"""
+
+from dlrover_tpu.serving.bucketing import (  # noqa: F401
+    make_buckets,
+    pad_to_bucket,
+    pick_bucket,
+)
+from dlrover_tpu.serving.engine import (  # noqa: F401
+    Request,
+    RequestResult,
+    ServingEngine,
+)

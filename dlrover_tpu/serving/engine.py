@@ -1,0 +1,396 @@
+"""Continuous-batching serving engine: host scheduler over the slot pool.
+
+The host side of the serving plane: an admission queue in front of the
+slotted decode programs (:mod:`dlrover_tpu.serving.decode`).  Each live
+request owns one KV-cache *slot*; a single jitted ``decode_step`` advances
+every occupied slot one token per call, and a request that finishes frees
+its slot for the next queued request **on the very next step** — no
+lockstep batch holding stragglers hostage (continuous batching).  Compare
+``static_batching=True``, the baseline ``tools/serve_bench.py`` measures
+against: admission waits until the whole pool drains, so every batch runs
+as long as its longest member.
+
+Integration points:
+
+* **Faultline** — every admission fires the ``serve.admit`` seam under the
+  PR-6 retry/deadline policy, so chaos plans cover the serving front door.
+* **Telemetry** — a ``serve`` event (QPS, latency p50/p95, slot occupancy)
+  is recorded on a step cadence; the master's servicer routes it into
+  ``SpeedMonitor.record_serve`` → ``dlrover_serve_*`` gauges → the
+  auto-scaler's latency/occupancy replica policy.
+* **AOT warm-start** — :meth:`aot_compile` compiles prefill-per-bucket +
+  insert + decode before the first request and books the wall time as a
+  compile-goodput event (``cached`` when the process-wide program memo
+  already holds the executables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common import faults, telemetry
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryPolicy
+from dlrover_tpu.models.transformer import TransformerConfig
+from dlrover_tpu.rl.generation import SamplingParams
+from dlrover_tpu.serving.bucketing import make_buckets, pad_to_bucket, \
+    pick_bucket
+from dlrover_tpu.serving.decode import get_programs
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``prompt`` is a 1-D int32 token array;
+    ``eos_id < 0`` disables early stop."""
+
+    uid: str
+    prompt: np.ndarray
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams
+    )
+    eos_id: int = -1
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """A finished request: generated tokens (prompt excluded) and their
+    logprobs under the raw next-token distribution."""
+
+    uid: str
+    prompt: np.ndarray
+    tokens: np.ndarray
+    logprobs: np.ndarray
+    submit_t: float
+    admitted_t: float
+    done_t: float
+
+    @property
+    def latency_s(self) -> float:
+        return self.done_t - self.submit_t
+
+    @property
+    def queue_s(self) -> float:
+        return self.admitted_t - self.submit_t
+
+
+class _SlotState:
+    __slots__ = (
+        "request", "generated", "logps", "submit_t", "admitted_t", "target"
+    )
+
+    def __init__(self, request: Request, submit_t: float,
+                 admitted_t: float):
+        self.request = request
+        self.generated: List[int] = []
+        self.logps: List[float] = []
+        self.submit_t = submit_t
+        self.admitted_t = admitted_t
+        self.target = request.sampling.max_new_tokens
+
+
+class ServingEngine:
+    """Slot-pool scheduler bound to one (config, params) pair."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        params,
+        *,
+        slots: int = 4,
+        buckets: Optional[Sequence[int]] = None,
+        max_top_k: int = 64,
+        seed: int = 0,
+        static_batching: bool = False,
+        telemetry_every: int = 32,
+        client=None,
+        admit_policy: Optional[RetryPolicy] = None,
+    ):
+        if buckets is None:
+            buckets = make_buckets(max(1, config.max_seq_len // 2))
+        self.programs = get_programs(
+            config, slots, tuple(buckets), max_top_k
+        )
+        self.params = params
+        self.slots = slots
+        self.buckets = self.programs.buckets
+        self.static_batching = static_batching
+        self.telemetry_every = max(1, telemetry_every)
+        self.client = client
+        self.cache = self.programs.init_cache(params)
+        self._rng = jax.random.PRNGKey(seed)
+        self._slot_state: List[Optional[_SlotState]] = [None] * slots
+        self._tokens = np.zeros((slots,), np.int32)
+        self._positions = np.zeros((slots,), np.int32)
+        self._temps = np.zeros((slots,), np.float32)
+        self._topks = np.zeros((slots,), np.int32)
+        self._queue: Deque[Tuple[Request, float]] = deque()
+        self.results: Dict[str, RequestResult] = {}
+        # The PR-6 front door: injected admission faults (serve.admit) are
+        # retried with backoff under a deadline instead of dropping the
+        # request on the floor.
+        self.admit_policy = admit_policy or RetryPolicy(
+            max_attempts=4, base_delay_s=0.05, max_delay_s=0.5,
+            deadline_s=5.0, retryable=(faults.FaultInjected,),
+            name="serve.admit", quiet=True,
+        )
+        self._step_i = 0
+        self._completed: Deque[Tuple[float, float, int]] = deque(maxlen=512)
+        self._occupancy: Deque[float] = deque(maxlen=256)
+        self._requests_done = 0
+        self._tokens_out = 0
+        self._submitted = 0
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(self, request: Request) -> str:
+        """Queue a request (validated + fault-seam guarded).  Raises
+        ``ValueError`` for never-admissible requests and ``RetryError``
+        when the admission seam stays down past the policy deadline."""
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError(f"request {request.uid}: empty prompt")
+        n_new = request.sampling.max_new_tokens
+        if n_new < 1:
+            raise ValueError(
+                f"request {request.uid}: max_new_tokens must be >= 1"
+            )
+        bucket = pick_bucket(prompt.size, self.buckets)
+        if bucket + n_new > self.programs.config.max_seq_len:
+            raise ValueError(
+                f"request {request.uid}: bucket {bucket} + max_new_tokens "
+                f"{n_new} exceeds max_seq_len "
+                f"{self.programs.config.max_seq_len}"
+            )
+        if request.sampling.top_k > max(1, self.programs.max_top_k):
+            raise ValueError(
+                f"request {request.uid}: top_k {request.sampling.top_k} "
+                f"exceeds the engine's max_top_k {self.programs.max_top_k}"
+            )
+        request = dataclasses.replace(request, prompt=prompt)
+        submit_t = time.perf_counter()
+
+        def admit():
+            faults.fire("serve.admit", uid=request.uid)
+            self._queue.append((request, submit_t))
+
+        self.admit_policy.call(admit)
+        self._submitted += 1
+        return request.uid
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slot_state) if s is None]
+
+    def _live_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slot_state) if s is not None]
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit_one(self, slot: int, request: Request, submit_t: float):
+        padded, true_len = pad_to_bucket(request.prompt, self.buckets)
+        state = _SlotState(
+            request, submit_t=submit_t, admitted_t=time.perf_counter()
+        )
+        s = request.sampling
+        row, first, logp = self.programs.prefill(
+            self.params,
+            jnp.asarray(padded[None, :]),
+            jnp.int32(true_len),
+            self._next_rng(),
+            jnp.full((1,), s.temperature, jnp.float32),
+            jnp.full((1,), s.top_k, jnp.int32),
+        )
+        self.cache = self.programs.insert(
+            self.cache, row, jnp.int32(slot)
+        )
+        first_tok = int(np.asarray(first)[0])
+        state.generated.append(first_tok)
+        state.logps.append(float(np.asarray(logp)[0]))
+        self._slot_state[slot] = state
+        self._tokens[slot] = first_tok
+        self._positions[slot] = true_len
+        self._temps[slot] = s.temperature
+        self._topks[slot] = s.top_k
+        if len(state.generated) >= state.target or (
+            request.eos_id >= 0 and first_tok == request.eos_id
+        ):
+            self._finish(slot)
+
+    def _finish(self, slot: int):
+        state = self._slot_state[slot]
+        assert state is not None
+        done_t = time.perf_counter()
+        result = RequestResult(
+            uid=state.request.uid,
+            prompt=state.request.prompt,
+            tokens=np.asarray(state.generated, np.int32),
+            logprobs=np.asarray(state.logps, np.float32),
+            submit_t=state.submit_t,
+            admitted_t=state.admitted_t,
+            done_t=done_t,
+        )
+        self.results[state.request.uid] = result
+        self._completed.append(
+            (done_t, result.latency_s, len(state.generated))
+        )
+        self._requests_done += 1
+        self._tokens_out += len(state.generated)
+        self._slot_state[slot] = None
+        self._tokens[slot] = 0
+        self._positions[slot] = 0
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+
+    # -- the step loop --------------------------------------------------------
+
+    def step(self) -> int:
+        """One scheduler tick: admit into free slots (continuous mode) or
+        into a drained pool (static mode), then advance every live slot
+        one token.  Returns the number of live slots decoded."""
+        self._step_i += 1
+        can_admit = (
+            not self.static_batching or not self._live_slots()
+        )
+        if can_admit:
+            for slot in self._free_slots():
+                if not self._queue:
+                    break
+                request, submit_t = self._queue.popleft()
+                self._admit_one(slot, request, submit_t)
+        live = self._live_slots()
+        if live:
+            self.cache, next_tokens, logps = self.programs.decode_step(
+                self.params,
+                self.cache,
+                jnp.asarray(self._tokens),
+                jnp.asarray(self._positions),
+                self._next_rng(),
+                jnp.asarray(self._temps),
+                jnp.asarray(self._topks),
+            )
+            next_np = np.asarray(next_tokens)
+            logp_np = np.asarray(logps)
+            for slot in live:
+                state = self._slot_state[slot]
+                tok = int(next_np[slot])
+                state.generated.append(tok)
+                state.logps.append(float(logp_np[slot]))
+                self._tokens[slot] = tok
+                self._positions[slot] += 1
+                if len(state.generated) >= state.target or (
+                    state.request.eos_id >= 0
+                    and tok == state.request.eos_id
+                ):
+                    self._finish(slot)
+        self._occupancy.append(len(live) / self.slots)
+        if self._step_i % self.telemetry_every == 0:
+            self._emit_telemetry()
+        return len(live)
+
+    def run(
+        self,
+        requests: Sequence[Request],
+        max_steps: Optional[int] = None,
+    ) -> Dict[str, RequestResult]:
+        """Submit ``requests`` and step until all complete."""
+        for request in requests:
+            self.submit(request)
+        return self.drain(max_steps=max_steps)
+
+    def drain(
+        self, max_steps: Optional[int] = None
+    ) -> Dict[str, RequestResult]:
+        if max_steps is None:
+            pending = len(self._queue) + len(self._live_slots())
+            max_steps = 64 + 2 * sum(
+                s.request.sampling.max_new_tokens
+                for s in self._slot_state if s is not None
+            ) + 2 * sum(
+                r.sampling.max_new_tokens for r, _ in self._queue
+            ) + 4 * pending
+        for _ in range(max_steps):
+            if not self._queue and not self._live_slots():
+                break
+            self.step()
+        else:
+            raise RuntimeError(
+                f"drain did not converge within {max_steps} steps "
+                f"(queue={len(self._queue)}, live={self._live_slots()})"
+            )
+        self._emit_telemetry()
+        return self.results
+
+    # -- stats / telemetry ----------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        latencies = sorted(lat for _, lat, _ in self._completed)
+        if len(self._completed) >= 2:
+            t_first = self._completed[0][0]
+            t_last = self._completed[-1][0]
+            qps = (
+                (len(self._completed) - 1) / (t_last - t_first)
+                if t_last > t_first else 0.0
+            )
+        else:
+            qps = 0.0
+
+        def q(p: float) -> float:
+            if not latencies:
+                return 0.0
+            return latencies[
+                min(len(latencies) - 1, int(p * len(latencies)))
+            ]
+
+        occupancy = (
+            sum(self._occupancy) / len(self._occupancy)
+            if self._occupancy else 0.0
+        )
+        return {
+            "qps": qps,
+            "p50_s": q(0.50),
+            "p95_s": q(0.95),
+            "occupancy": occupancy,
+            "slots": float(self.slots),
+            "requests": float(self._requests_done),
+            "tokens": float(self._tokens_out),
+            "steps": float(self._step_i),
+        }
+
+    def _emit_telemetry(self):
+        stats = self.stats()
+        telemetry.event(
+            "serve",
+            qps=stats["qps"], p50_s=stats["p50_s"], p95_s=stats["p95_s"],
+            occupancy=stats["occupancy"], slots=int(stats["slots"]),
+            requests=int(stats["requests"]), tokens=int(stats["tokens"]),
+        )
+
+    # -- AOT warm-start -------------------------------------------------------
+
+    def aot_compile(self) -> float:
+        """Compile every serving program ahead of the first request and
+        book the wall time as a compile-goodput event (``cached=True``
+        when the program memo already held the executables — the warm
+        start an elastic serving replica restart should hit)."""
+        seconds = self.programs.aot_compile(self.params)
+        detail = {
+            "seconds": round(seconds, 6),
+            "restart": False,
+            "cached": seconds == 0.0,
+            "phase": "serve_aot",
+        }
+        logger.info("serve AOT warmup: %s", detail)
+        telemetry.event("compile", duration_s=seconds,
+                        cached=detail["cached"], phase="serve_aot")
+        if self.client is not None:
+            self.client.report_event("compile", json.dumps(detail))
+        return seconds

@@ -1,0 +1,88 @@
+"""Minimal serving example: continuous-batching decode on a toy LM.
+
+Builds a small TransformerLM, AOT-warms the serving programs, then runs a
+handful of mixed-length requests with per-request sampling params through
+the continuous-batching engine and prints each result.
+
+    JAX_PLATFORMS=cpu python examples/serve_lm.py --slots 4 --requests 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="toy continuous-batching demo")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--max-seq-len", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from dlrover_tpu.rl.generation import SamplingParams
+    from dlrover_tpu.serving import Request, ServingEngine
+
+    config = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        num_heads=args.heads, num_layers=args.layers,
+        d_ff=args.d_model * 2, max_seq_len=args.max_seq_len,
+    )
+    params = TransformerLM(config).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+
+    engine = ServingEngine(
+        config, params, slots=args.slots, seed=args.seed
+    )
+    aot_s = engine.aot_compile()
+    print(f"AOT warmup: {aot_s:.2f}s "
+          f"(buckets {engine.buckets}, slots {args.slots})")
+
+    rng = np.random.RandomState(args.seed)
+    requests = []
+    for i in range(args.requests):
+        prompt = rng.randint(
+            1, args.vocab, size=3 + (5 * i) % 13
+        ).astype(np.int32)
+        requests.append(Request(
+            f"req{i}", prompt,
+            SamplingParams(
+                temperature=0.0 if i % 2 == 0 else 0.8,
+                top_k=0 if i % 2 == 0 else 8,
+                max_new_tokens=2 + (3 * i) % args.max_new,
+            ),
+        ))
+    results = engine.run(requests)
+    for req in requests:
+        r = results[req.uid]
+        print(f"{r.uid}: prompt[{len(r.prompt)}] -> "
+              f"{r.tokens.tolist()} ({r.latency_s * 1e3:.1f} ms)")
+    stats = engine.stats()
+    print(f"stats: qps={stats['qps']:.1f} p50={stats['p50_s'] * 1e3:.1f}ms "
+          f"p95={stats['p95_s'] * 1e3:.1f}ms "
+          f"occupancy={stats['occupancy']:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -93,6 +93,24 @@ def test_sdc_flip_fires_deterministically_at_the_scripted_hit():
     assert plan is not None and ("sdc.flip", "error", 2) in plan.fired
 
 
+def test_serve_admit_seam_is_known_and_plans_parse():
+    """The serving front door's seam speaks the standard grammar — and a
+    fired error is the retryable FaultInjected the engine's admission
+    RetryPolicy expects."""
+    assert "serve.admit" in faults.KNOWN_SEAMS
+    rules = faults.parse_plan(
+        "serve.admit:error@1;serve.admit:delay=0.01@every:3"
+    )
+    assert rules[0].kind == "error" and rules[0].hits == {1}
+    assert rules[1].kind == "delay" and rules[1].every == 3
+    assert faults.parse_plan("serve.admit:error@p=0.25")[0].prob == 0.25
+    faults.configure("serve.admit:error@2", seed=3)
+    faults.fire("serve.admit", uid="r0")
+    with pytest.raises(faults.FaultInjected) as ei:
+        faults.fire("serve.admit", uid="r1")
+    assert ei.value.seam == "serve.admit" and ei.value.hit == 2
+
+
 @pytest.mark.parametrize("bad", [
     "storage.write",                 # no kind
     "storage.write:explode",         # unknown kind

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+import trace_asserts
 
 from dlrover_tpu.models.gpt2 import gpt2_config
 from dlrover_tpu.models.transformer import TransformerLM
@@ -115,6 +116,70 @@ def test_generation_backend_greedy_matches_reforward_argmax():
         nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(tokens), seq)
+
+
+def test_sampler_top_k_matches_sort_reference():
+    """The lax.top_k threshold must filter exactly like the old
+    full-vocab-sort reference: same kth value, same surviving logits,
+    so `categorical` under the same key draws the same token."""
+    cfg = _cfg()
+    k = 5
+    backend = GenerationBackend(
+        cfg, SamplingParams(max_new_tokens=2, temperature=0.7, top_k=k)
+    )
+    logits = jax.random.normal(jax.random.PRNGKey(4), (3, VOCAB))
+    rng = jax.random.PRNGKey(9)
+    got = backend._sample(logits, rng)
+
+    scaled = logits.astype(jnp.float32) / 0.7
+    kth = jnp.sort(scaled, axis=-1)[..., -k][..., None]
+    ref_filtered = jnp.where(scaled >= kth, scaled, -1e15)
+    ref = jax.random.categorical(rng, ref_filtered, axis=-1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # Every drawn token sits inside the row's true top-k set.
+    topk_idx = np.asarray(jax.lax.top_k(scaled, k)[1])
+    for row, tok in enumerate(np.asarray(got)):
+        assert tok in topk_idx[row]
+
+
+def test_prompt_buckets_share_one_trace():
+    """Two distinct prompt widths inside one bucket must compile the
+    generate program ONCE (the anti-recompile contract the serving
+    bucketer gives rollouts) and pad causally inertly: on an exact-width
+    prompt the bucketed backend matches the unbucketed one bitwise."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32)
+    )["params"]
+    sampling = SamplingParams(max_new_tokens=4, temperature=0.0)
+    backend = GenerationBackend(cfg, sampling, prompt_buckets=(8, 16))
+    rng = jax.random.PRNGKey(3)
+
+    tokens5, _ = backend.generate(
+        params, jax.random.randint(jax.random.PRNGKey(5), (2, 5), 1, VOCAB),
+        rng,
+    )
+    with trace_asserts.assert_no_retrace("generate"):
+        tokens7, _ = backend.generate(
+            params,
+            jax.random.randint(jax.random.PRNGKey(6), (2, 7), 1, VOCAB),
+            rng,
+        )
+    # Both padded to the 8-wide bucket: same output width.
+    assert tokens5.shape == (2, 12) and tokens7.shape == (2, 12)
+
+    # Exact-width prompt: bucketed == unbucketed, bitwise.
+    plain = GenerationBackend(cfg, sampling)
+    prompts = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 1, VOCAB)
+    bucketed_tokens, bucketed_logps = backend.generate(params, prompts, rng)
+    plain_tokens, plain_logps = plain.generate(params, prompts, rng)
+    np.testing.assert_array_equal(
+        np.asarray(bucketed_tokens), np.asarray(plain_tokens)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bucketed_logps), np.asarray(plain_logps)
+    )
 
 
 # ---------------------------------------------------------------------------

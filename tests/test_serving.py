@@ -1,0 +1,497 @@
+"""Serving plane: slotted KV cache, continuous batching, AOT warm-start.
+
+Tier-1 coverage for ``dlrover_tpu/serving/``:
+
+1. bucketing units — geometric widths, admission, right-padding;
+2. the vectorized sampler — greedy/temperature/top-k rows in one program,
+   parity against the full-sort reference;
+3. the decode programs — greedy parity (slotted ``decode_step`` vs the RL
+   scan decode, bitwise tokens), slot-recycle hygiene (a freed slot's
+   stale K/V never leaks into its next tenant);
+4. the engine — continuous admission beats the static barrier on decode
+   steps, steady-state runs with ZERO retraces, per-request sampling mixes
+   in one batch, eos termination, the ``serve.admit`` fault seam under the
+   admission RetryPolicy;
+5. AOT warm-start — a second engine on the same serve key pays zero
+   trace/compile; distinct keys for distinct pool shapes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import trace_asserts
+
+from dlrover_tpu.common import faults, telemetry
+from dlrover_tpu.common.retry import RetryError, RetryPolicy
+from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
+from dlrover_tpu.rl.generation import GenerationBackend, SamplingParams
+from dlrover_tpu.runtime.compile_cache import serve_cache_key
+from dlrover_tpu.serving import (
+    Request,
+    ServingEngine,
+    make_buckets,
+    pad_to_bucket,
+    pick_bucket,
+)
+from dlrover_tpu.serving.decode import sample_tokens
+
+VOCAB, SEQ = 64, 32
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leaks():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, num_heads=4, num_layers=2,
+        d_ff=64, max_seq_len=SEQ,
+    )
+    params = TransformerLM(config).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return config, params
+
+
+def _prompt(key, n):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(key), (n,), 1, VOCAB),
+        np.int32,
+    )
+
+
+# -- bucketing ----------------------------------------------------------------
+
+
+def test_make_buckets_geometric_and_clamped():
+    assert make_buckets(100, start=16) == (16, 32, 64, 100)
+    assert make_buckets(16, start=16) == (16,)
+    assert make_buckets(8, start=16) == (8,)
+    with pytest.raises(ValueError):
+        make_buckets(0)
+    with pytest.raises(ValueError):
+        make_buckets(10, factor=1)
+
+
+def test_pick_bucket_smallest_admitting():
+    assert pick_bucket(5, (8, 16)) == 8
+    assert pick_bucket(8, (8, 16)) == 8
+    assert pick_bucket(9, (16, 8)) == 16  # order-insensitive
+    with pytest.raises(ValueError, match="exceeds"):
+        pick_bucket(17, (8, 16))
+    with pytest.raises(ValueError):
+        pick_bucket(0, (8,))
+
+
+def test_pad_to_bucket_right_pads_and_reports_true_len():
+    padded, true_len = pad_to_bucket(np.arange(1, 6), (8, 16), pad_id=0)
+    assert true_len == 5
+    np.testing.assert_array_equal(
+        padded, [1, 2, 3, 4, 5, 0, 0, 0]
+    )
+    exact, n = pad_to_bucket(np.arange(8), (8,))
+    assert n == 8 and exact.shape == (8,)
+    two_d, n = pad_to_bucket(np.ones((3, 5), np.int32), (8,))
+    assert n == 5 and two_d.shape == (3, 8)
+
+
+# -- vectorized sampler -------------------------------------------------------
+
+
+def test_sample_tokens_greedy_and_mixed_rows():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (4, VOCAB))
+    rng = jax.random.PRNGKey(2)
+    temps = jnp.asarray([0.0, 0.0, 1.0, 0.5])
+    topks = jnp.asarray([0, 0, 0, 4], jnp.int32)
+    tokens, logps = sample_tokens(logits, rng, temps, topks, max_top_k=8)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    got = np.asarray(tokens)
+    # temp==0 rows are exact argmax regardless of the rng.
+    np.testing.assert_array_equal(got[:2], greedy[:2])
+    # top-k row draws inside its top-k set.
+    top4 = np.asarray(jax.lax.top_k(logits[3] / 0.5, 4)[1])
+    assert got[3] in top4
+    # Logprobs are of the returned token under the RAW distribution.
+    ref_logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    np.testing.assert_allclose(
+        np.asarray(logps), ref_logp[np.arange(4), got], rtol=1e-6
+    )
+
+
+def test_sample_tokens_top_k_matches_sort_reference():
+    """The lax.top_k threshold filters exactly like a full-vocab sort, so
+    the same key draws the same token from the same surviving set."""
+    logits = jax.random.normal(jax.random.PRNGKey(3), (5, VOCAB))
+    rng = jax.random.PRNGKey(4)
+    k = 6
+    temps = jnp.full((5,), 0.8)
+    topks = jnp.full((5,), k, jnp.int32)
+    tokens, _ = sample_tokens(logits, rng, temps, topks, max_top_k=16)
+
+    scaled = logits.astype(jnp.float32) / 0.8
+    kth = jnp.sort(scaled, axis=-1)[..., -k][..., None]
+    ref_scaled = jnp.where(scaled < kth, -1e15, scaled)
+    ref = jax.random.categorical(rng, ref_scaled, axis=-1)
+    np.testing.assert_array_equal(np.asarray(tokens), np.asarray(ref))
+
+
+# -- greedy parity: slotted decode vs the RL scan decode ----------------------
+
+
+def test_slotted_greedy_parity_with_scan_decode(setup):
+    """temperature=0 through the slot pool must reproduce the RL scan
+    engine token-for-token (and logprob-for-logprob): same params, same
+    prompts, two completely different compiled decode paths."""
+    config, params = setup
+    n_new = 6
+    prompts = jax.random.randint(jax.random.PRNGKey(11), (3, 8), 1, VOCAB)
+
+    backend = GenerationBackend(
+        config, SamplingParams(temperature=0.0, max_new_tokens=n_new)
+    )
+    ref_tokens, ref_logps = backend.generate(
+        params, prompts, jax.random.PRNGKey(0)
+    )
+    ref_tokens = np.asarray(ref_tokens)[:, 8:]
+    ref_logps = np.asarray(ref_logps)
+
+    engine = ServingEngine(
+        config, params, slots=3, buckets=(8, 16), seed=0
+    )
+    results = engine.run([
+        Request(
+            f"r{i}", np.asarray(prompts[i]),
+            SamplingParams(temperature=0.0, max_new_tokens=n_new),
+        )
+        for i in range(3)
+    ])
+    for i in range(3):
+        r = results[f"r{i}"]
+        np.testing.assert_array_equal(r.tokens, ref_tokens[i])
+        np.testing.assert_allclose(
+            r.logprobs, ref_logps[i], rtol=1e-5, atol=1e-5
+        )
+
+
+def test_slot_recycle_never_leaks_stale_kv(setup):
+    """A freed slot's next tenant must see ONLY its own K/V: request B
+    through a recycled slot matches B through a fresh engine bitwise."""
+    config, params = setup
+    greedy = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompt_a = _prompt(21, 14)   # long prompt fills the slot's cache row
+    prompt_b = _prompt(22, 5)
+
+    recycled = ServingEngine(
+        config, params, slots=1, buckets=(8, 16), seed=0
+    )
+    recycled.run([Request("a", prompt_a, greedy)])
+    got_b = recycled.run([Request("b", prompt_b, greedy)])["b"]
+
+    fresh = ServingEngine(
+        config, params, slots=1, buckets=(8, 16), seed=0
+    )
+    want_b = fresh.run([Request("b", prompt_b, greedy)])["b"]
+    np.testing.assert_array_equal(got_b.tokens, want_b.tokens)
+    np.testing.assert_allclose(
+        got_b.logprobs, want_b.logprobs, rtol=1e-6
+    )
+
+
+# -- the engine ---------------------------------------------------------------
+
+
+def test_continuous_admission_beats_static_barrier(setup):
+    """Heterogeneous decode lengths: the continuous engine refills freed
+    slots mid-flight, finishing the same work in fewer decode steps and
+    higher occupancy than the static all-slots-drain baseline."""
+    config, params = setup
+
+    def run(static):
+        engine = ServingEngine(
+            config, params, slots=2, buckets=(8,), seed=0,
+            static_batching=static,
+        )
+        requests = [
+            Request(
+                f"r{i}", _prompt(30 + i, 4 + i % 3),
+                SamplingParams(
+                    temperature=0.0, max_new_tokens=(3, 12, 5, 10)[i]
+                ),
+            )
+            for i in range(4)
+        ]
+        results = engine.run(requests)
+        assert len(results) == 4
+        for i in range(4):
+            assert len(results[f"r{i}"].tokens) == (3, 12, 5, 10)[i]
+        return results, engine.stats()
+
+    continuous_results, continuous = run(static=False)
+    static_results, static = run(static=True)
+    assert continuous["steps"] < static["steps"]
+    assert continuous["occupancy"] > static["occupancy"]
+    # Same greedy work either way — scheduling must not change tokens.
+    for i in range(4):
+        np.testing.assert_array_equal(
+            continuous_results[f"r{i}"].tokens,
+            static_results[f"r{i}"].tokens,
+        )
+
+
+def test_steady_state_decode_never_retraces(setup):
+    """After one request per bucket has warmed the programs, a whole
+    mixed-traffic run must trigger ZERO fresh traces of prefill, insert,
+    or decode — the continuous-batching anti-recompile contract."""
+    config, params = setup
+    engine = ServingEngine(
+        config, params, slots=4, buckets=(8, 16), seed=1
+    )
+    warmup = [
+        Request("w0", _prompt(40, 5),
+                SamplingParams(temperature=0.0, max_new_tokens=2)),
+        Request("w1", _prompt(41, 12),
+                SamplingParams(temperature=0.7, max_new_tokens=2)),
+    ]
+    engine.run(warmup)
+    with trace_asserts.assert_no_retrace(
+        "serve_prefill", "serve_insert", "serve_decode"
+    ):
+        results = engine.run([
+            Request(
+                f"r{i}", _prompt(50 + i, 3 + (5 * i) % 12),
+                SamplingParams(
+                    temperature=(0.0, 0.9)[i % 2],
+                    top_k=(0, 5)[i % 2],
+                    max_new_tokens=2 + i % 7,
+                ),
+            )
+            for i in range(10)
+        ])
+    # run() returns the engine's accumulated results; all ten landed.
+    assert all(f"r{i}" in results for i in range(10))
+
+
+def test_mixed_per_request_sampling_in_one_batch(setup):
+    """Greedy and sampled requests share one decode batch; the greedy
+    rows must be unaffected by their neighbours' temperatures."""
+    config, params = setup
+    greedy = SamplingParams(temperature=0.0, max_new_tokens=5)
+    prompt = _prompt(60, 6)
+
+    solo = ServingEngine(config, params, slots=1, buckets=(8,), seed=0)
+    want = solo.run([Request("g", prompt, greedy)])["g"]
+
+    mixed = ServingEngine(config, params, slots=3, buckets=(8,), seed=5)
+    results = mixed.run([
+        Request("g", prompt, greedy),
+        Request("s1", _prompt(61, 4),
+                SamplingParams(temperature=1.2, top_k=8,
+                               max_new_tokens=7)),
+        Request("s2", _prompt(62, 7),
+                SamplingParams(temperature=0.8, max_new_tokens=3)),
+    ])
+    np.testing.assert_array_equal(results["g"].tokens, want.tokens)
+    assert len(results["s1"].tokens) == 7
+    assert len(results["s2"].tokens) == 3
+
+
+def test_eos_terminates_early_and_frees_the_slot(setup):
+    """A request whose eos lands mid-stream stops there; the freed slot
+    is immediately reusable."""
+    config, params = setup
+    prompt = _prompt(70, 5)
+    engine = ServingEngine(config, params, slots=1, buckets=(8,), seed=0)
+    full = engine.run([
+        Request("full", prompt,
+                SamplingParams(temperature=0.0, max_new_tokens=6)),
+    ])["full"]
+    assert len(full.tokens) == 6
+    eos = int(full.tokens[2])
+    # The greedy stream may repeat tokens — the stop lands at the FIRST
+    # occurrence of the eos value, which is at index <= 2.
+    stop = int(np.argmax(full.tokens == eos))
+    early = engine.run([
+        Request("early", prompt,
+                SamplingParams(temperature=0.0, max_new_tokens=6),
+                eos_id=eos),
+    ])["early"]
+    np.testing.assert_array_equal(early.tokens, full.tokens[:stop + 1])
+    # Pool is free again: another full request still works.
+    again = engine.run([
+        Request("again", prompt,
+                SamplingParams(temperature=0.0, max_new_tokens=6)),
+    ])["again"]
+    np.testing.assert_array_equal(again.tokens, full.tokens)
+
+
+def test_submit_rejects_never_admissible_requests(setup):
+    config, params = setup
+    engine = ServingEngine(config, params, slots=1, buckets=(8, 16))
+    with pytest.raises(ValueError, match="empty"):
+        engine.submit(Request("e", np.zeros((0,), np.int32)))
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.submit(Request("long", _prompt(80, 17)))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.submit(Request(
+            "fat", _prompt(81, 16),
+            SamplingParams(max_new_tokens=SEQ),
+        ))
+    with pytest.raises(ValueError, match="max_top_k"):
+        engine.submit(Request(
+            "wide", _prompt(82, 4),
+            SamplingParams(top_k=2 * VOCAB, max_new_tokens=2),
+        ))
+
+
+# -- fault seam ---------------------------------------------------------------
+
+
+def test_serve_admit_fault_is_retried_then_admits(setup):
+    """An injected admission error is absorbed by the engine's
+    RetryPolicy: the request still lands, and the fault is booked as a
+    telemetry event (the master's Faultline ledger path)."""
+    config, params = setup
+    rec = telemetry.recorder()
+    was = rec.enabled
+    rec.configure(enabled=True)
+    rec.drain()
+    try:
+        faults.configure("serve.admit:error@1")
+        engine = ServingEngine(
+            config, params, slots=1, buckets=(8,), seed=0,
+            admit_policy=RetryPolicy(
+                max_attempts=3, base_delay_s=0.0, jitter=False,
+                retryable=(faults.FaultInjected,), name="serve.admit",
+                quiet=True,
+            ),
+        )
+        results = engine.run([
+            Request("r", _prompt(90, 4),
+                    SamplingParams(temperature=0.0, max_new_tokens=2)),
+        ])
+        events = rec.drain()
+    finally:
+        rec.configure(enabled=was)
+    assert len(results["r"].tokens) == 2
+    fault_events = [e for e in events if e[0] == "fault"]
+    assert len(fault_events) == 1
+    assert fault_events[0][4]["seam"] == "serve.admit"
+
+
+def test_serve_admit_fault_exhausts_policy(setup):
+    """A persistently-down admission seam surfaces as RetryError — the
+    request is rejected loudly, not silently dropped."""
+    config, params = setup
+    faults.configure("serve.admit:error")  # every hit fires
+    engine = ServingEngine(
+        config, params, slots=1, buckets=(8,), seed=0,
+        admit_policy=RetryPolicy(
+            max_attempts=2, base_delay_s=0.0, jitter=False,
+            retryable=(faults.FaultInjected,), name="serve.admit",
+            quiet=True,
+        ),
+    )
+    with pytest.raises(RetryError):
+        engine.submit(Request("r", _prompt(91, 4)))
+
+
+# -- AOT warm-start + cache keys ----------------------------------------------
+
+
+def test_aot_warm_start_second_engine_is_free(setup):
+    """First engine on a FRESH serve key pays the cold AOT compile; a
+    second engine on the same key pays zero seconds and zero traces —
+    the `cached` compile the goodput ledger books."""
+    config, params = setup
+    # d_ff=96 gives this test its own serve key even though the module
+    # memo is warm from the other tests.
+    cfg = dataclasses.replace(config, d_ff=96)
+    prms = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    cold_engine = ServingEngine(cfg, prms, slots=2, buckets=(8,), seed=0)
+    cold_s = cold_engine.aot_compile()
+    assert cold_s > 0.0
+    assert cold_engine.aot_compile() == 0.0  # idempotent
+
+    warm_engine = ServingEngine(cfg, prms, slots=2, buckets=(8,), seed=1)
+    with trace_asserts.assert_no_retrace(
+        "serve_prefill", "serve_insert", "serve_decode"
+    ):
+        warm_s = warm_engine.aot_compile()
+        results = warm_engine.run([
+            Request("r", _prompt(95, 5),
+                    SamplingParams(temperature=0.0, max_new_tokens=3)),
+        ])
+    assert warm_s == 0.0
+    assert len(results["r"].tokens) == 3
+
+
+def test_serve_cache_key_distinguishes_pool_shapes(setup):
+    config, _ = setup
+    base = serve_cache_key(config, slots=4, buckets=(8, 16), max_top_k=8)
+    assert base == serve_cache_key(
+        config, slots=4, buckets=(8, 16), max_top_k=8
+    )
+    assert base != serve_cache_key(
+        config, slots=8, buckets=(8, 16), max_top_k=8
+    )
+    assert base != serve_cache_key(
+        config, slots=4, buckets=(8,), max_top_k=8
+    )
+    assert base != serve_cache_key(
+        config, slots=4, buckets=(8, 16), max_top_k=16
+    )
+    other = dataclasses.replace(config, d_model=64)
+    assert base != serve_cache_key(
+        other, slots=4, buckets=(8, 16), max_top_k=8
+    )
+    assert base != serve_cache_key(
+        config, mesh_shape=(2,), slots=4, buckets=(8, 16), max_top_k=8
+    )
+
+
+def test_engine_telemetry_event_shape(setup):
+    """The engine's ``serve`` event carries exactly the attrs the
+    master's record_serve ingests (and none of telemetry's reserved
+    names)."""
+    config, params = setup
+    rec = telemetry.recorder()
+    was = rec.enabled
+    rec.configure(enabled=True)
+    rec.drain()
+    try:
+        engine = ServingEngine(
+            config, params, slots=2, buckets=(8,), seed=0,
+            telemetry_every=1,
+        )
+        engine.run([
+            Request("r", _prompt(97, 4),
+                    SamplingParams(temperature=0.0, max_new_tokens=3)),
+        ])
+        events = rec.drain()
+    finally:
+        rec.configure(enabled=was)
+    serve_events = [e for e in events if e[0] == "serve"]
+    assert serve_events
+    attrs = dict(serve_events[-1][4])
+    attrs.pop("src", None)  # stamped by the recorder, not the engine
+    assert set(attrs) == {
+        "qps", "p50_s", "p95_s", "occupancy", "slots", "requests",
+        "tokens",
+    }
+    assert attrs["requests"] == 1 and attrs["tokens"] == 3
+
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor()
+    sm.record_serve(0, **attrs)
+    ledger = sm.serve_ledger()
+    assert ledger["replicas"] == 1 and ledger["tokens"] == 3

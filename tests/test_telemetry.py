@@ -361,6 +361,8 @@ def test_render_metrics_goodput_matches_speed_monitor():
     sm.record_compile(4.2, restart=True)
     sm.record_anomaly(5, "nan@5:loss=nan")
     sm.record_anomaly(6, "loss_spike@6:loss=9.0")
+    sm.record_serve(0, qps=20.0, p50_s=0.02, p95_s=0.08, occupancy=0.75,
+                    slots=4, requests=50, tokens=800)
     timeline = _skewed_timeline()
     text = timeline.render_metrics(speed_monitor=sm)
     metrics = {}
@@ -384,6 +386,18 @@ def test_render_metrics_goodput_matches_speed_monitor():
     assert metrics['dlrover_step_time_seconds{node="2",quantile="0.50"}'] \
         == pytest.approx(0.3)
     assert metrics['dlrover_slowest_steps_total{node="2"}'] == 12
+    # Serving-plane gauges come off the serve ledger.
+    assert metrics["dlrover_serve_qps"] == pytest.approx(20.0)
+    assert metrics['dlrover_serve_latency_seconds{quantile="0.5"}'] == (
+        pytest.approx(0.02)
+    )
+    assert metrics['dlrover_serve_latency_seconds{quantile="0.95"}'] == (
+        pytest.approx(0.08)
+    )
+    assert metrics["dlrover_serve_slot_occupancy"] == pytest.approx(0.75)
+    assert metrics["dlrover_serve_requests_total"] == 50
+    assert metrics["dlrover_serve_tokens_total"] == 800
+    assert metrics["dlrover_serve_replicas"] == 1
 
 
 def test_render_metrics_includes_node_manager_relaunches():

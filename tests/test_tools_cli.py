@@ -60,6 +60,17 @@ def test_goodput_bench_help(cpu_child_env):
     assert "--sdc-flip-hit" in out.stdout
 
 
+def test_serve_bench_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--slots" in out.stdout and "--out" in out.stdout
+    assert "--buckets" in out.stdout and "--requests" in out.stdout
+
+
 def test_tracelint_json_smoke(tmp_path, cpu_child_env):
     """``tracelint --json`` over a trivially clean dir: exit 0 and a
     well-formed report payload."""

@@ -67,10 +67,13 @@ def write_device_metrics(path: Optional[str] = None) -> Optional[Dict]:
     }
     tmp = path + ".tmp"
     try:
+        # Seam: the metrics handoff file is a real storage write; a fired
+        # fault exercises the degraded path (agent sees stale/no HBM data).
+        faults.fire("storage.write", path=os.path.basename(path))
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, path)
-    except OSError as e:
+    except (OSError, faults.FaultInjected) as e:
         logger.debug("device metrics write failed: %s", e)
     return payload
 

@@ -48,7 +48,9 @@ def install_stack_dump_handler(path: Optional[str] = None) -> Optional[str]:
                                                      "register"):
         return None  # non-POSIX platform: no signal-triggered dumps
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    f = open(path, "w")  # noqa: SIM115 - must outlive this frame
+    # Crash-dump channel: injecting a fault into the stack-dump file
+    # would mask the incident being diagnosed, so no Faultline seam.
+    f = open(path, "w")  # noqa: SIM115  # tracelint: disable=SEAM001
     faulthandler.register(signal.SIGUSR1, file=f, all_threads=True,
                           chain=False)
     if _registered_file is not None:
@@ -85,7 +87,7 @@ def collect_stacks(pid: int, path: str, timeout_s: float = 3.0) -> str:
                 # faulthandler writes the whole dump in one go; a short
                 # settle covers the multi-thread case.
                 time.sleep(0.1)
-                with open(path, "r", errors="replace") as f:
+                with open(path, errors="replace") as f:
                     f.seek(before)
                     return f.read()
         except OSError:

@@ -55,7 +55,9 @@ def _routable_ip(master_addr: str) -> str:
 
     host = master_addr.rsplit(":", 1)[0] or "localhost"
     try:
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        # Connected-UDP local-address probe: the kernel resolves the
+        # route without sending a packet, so there is no I/O to seam.
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:  # tracelint: disable=SEAM001
             s.connect((host, 1))
             ip = s.getsockname()[0]
         if not ip.startswith("127."):
@@ -270,6 +272,9 @@ class ElasticAgent:
         self._paral_config_version = config.version
         path = self._paral_config_file()
         tmp = path + ".tmp"
+        # Seam: config handoff to the trainer is a storage write the
+        # drills must reach (a torn config file is a real incident).
+        faults.fire("storage.write", path=os.path.basename(path))
         with open(tmp, "w") as f:
             json.dump(_dc.asdict(config), f)
         os.replace(tmp, path)
@@ -357,7 +362,9 @@ class ElasticAgent:
         )
         if self._restart_count >= 2 and os.path.exists(stale):
             try:
-                os.remove(stale)
+                # Best-effort retention sweep of our own old log; failure
+                # is already tolerated, nothing for a drill to surface.
+                os.remove(stale)  # tracelint: disable=SEAM001
             except OSError:
                 pass
         self._proc = subprocess.Popen(
@@ -436,8 +443,11 @@ class ElasticAgent:
         """
         sinks = {"stdout": True, "file": True}
         try:
+            # Seam: a fired fault drops the file sink exactly like an
+            # unwritable disk would — draining must continue regardless.
+            faults.fire("storage.write", path=os.path.basename(log_path))
             log = open(log_path, "wb", buffering=0)
-        except OSError:
+        except (OSError, faults.FaultInjected):
             log, sinks["file"] = None, False
         try:
             for line in iter(stream.readline, b""):

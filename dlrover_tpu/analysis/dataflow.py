@@ -1,0 +1,460 @@
+"""Intra-procedural dataflow: statement CFG + reaching definitions.
+
+The engine tracelint v2 rules build on.  ``FunctionDataflow`` turns one
+function body into a statement-level control-flow graph (branches, loops,
+``with`` bodies, ``try`` blocks, ``break``/``continue``/``return``) and
+answers the query the donation rules need: *given this statement, which
+later reads of binding X can observe the value X holds right now?* —
+i.e. reads reachable along some CFG path with no intervening
+redefinition.
+
+Bindings are plain local names (``pool``) and simple ``self.attr``
+chains (tracked as the pseudo-name ``"self.attr"``) — the two spellings
+the serving donated-pool and train-state-carry idioms actually use.
+Everything else (subscripts, deep attribute chains, globals) is out of
+scope on purpose: this is a linter, and the approximation errs toward
+silence, with inline suppressions for the residue (same philosophy as
+:mod:`dlrover_tpu.analysis.jaxast`).
+
+Like the rest of the analysis package this is pure-stdlib ``ast`` — no
+JAX import, so the tier-1 gate can run it in any child process.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.jaxast import FUNCTION_NODES, FunctionNode
+
+#: CFG node ids are indices into ``FunctionDataflow.statements``.
+ENTRY = -1
+EXIT = -2
+
+
+def _target_names(target: ast.AST) -> Iterator[str]:
+    """Binding names produced by one assignment target: plain names,
+    ``self.attr`` pseudo-names, tuple/list unpacking, starred elements."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, ast.Attribute):
+        name = _self_attr(target)
+        if name:
+            yield name
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _target_names(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _target_names(target.value)
+    # Subscripts (x[i] = ...) do not rebind x — the donated buffer is
+    # still the one being written to, so they are uses, not kills.
+
+
+def self_attr(node: ast.Attribute) -> str:
+    """``"self.cache"`` for a one-level attribute on ``self``, else ""."""
+    if isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    return ""
+
+
+_self_attr = self_attr  # internal alias used below
+
+
+def stmt_defs(stmt: ast.stmt) -> Set[str]:
+    """Names (re)bound by ``stmt`` itself — its kill set."""
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            out.update(_target_names(target))
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        out.update(_target_names(stmt.target))
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        out.update(_target_names(stmt.target))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out.update(_target_names(item.optional_vars))
+    elif isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+        out.add(stmt.name)
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            out.add((alias.asname or alias.name).split(".")[0])
+    elif isinstance(stmt, ast.Delete):
+        for target in stmt.targets:
+            out.update(_target_names(target))
+    elif isinstance(stmt, ast.ExceptHandler):
+        if stmt.name:
+            out.add(stmt.name)
+        return out  # body statements are their own CFG nodes
+    # Walrus targets nested in the statement's own expressions.
+    for node in _own_expr_nodes(stmt):
+        if isinstance(node, ast.NamedExpr):
+            out.update(_target_names(node.target))
+    return out
+
+
+def _own_expr_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Expression nodes evaluated *by this statement itself* — compound
+    statements contribute only their header expressions (an ``if``'s
+    test, a ``for``'s iter), never their bodies, which are separate CFG
+    statements.  Nested function/class defs contribute nothing: their
+    bodies run later, under closure semantics (see ``closure_reads``)."""
+    if isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+        for dec in stmt.decorator_list:
+            yield from ast.walk(dec)
+        return
+    headers: Sequence[Optional[ast.AST]]
+    if isinstance(stmt, ast.If):
+        headers = [stmt.test]
+    elif isinstance(stmt, ast.While):
+        headers = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        headers = [stmt.iter, stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        headers = [item.context_expr for item in stmt.items]
+    elif isinstance(stmt, ast.ExceptHandler):
+        headers = [stmt.type]
+    elif isinstance(stmt, ast.Try):
+        headers = []
+    else:
+        yield from ast.walk(stmt)
+        return
+    for header in headers:
+        if header is not None:
+            yield from ast.walk(header)
+
+
+def stmt_uses(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+    """``(binding, node)`` for every read of a tracked binding performed
+    by ``stmt`` itself (headers only for compound statements)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in _own_expr_nodes(stmt):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.append((node.id, node))
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            name = _self_attr(node)
+            if name:
+                out.append((name, node))
+    return out
+
+
+def closure_reads(fn: FunctionNode) -> Dict[str, List[ast.AST]]:
+    """Names read inside functions/lambdas *nested in* ``fn`` that are
+    not rebound locally there — the closure-captured reads.  Maps the
+    captured name to the reading nodes (approximate: a nested def's own
+    parameters and assignments shadow the capture)."""
+    out: Dict[str, List[ast.AST]] = {}
+
+    def local_names(inner) -> Set[str]:
+        names: Set[str] = set()
+        if isinstance(inner, FUNCTION_NODES):
+            args = inner.args
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+            ):
+                names.add(a.arg)
+            if args.vararg:
+                names.add(args.vararg.arg)
+            if args.kwarg:
+                names.add(args.kwarg.arg)
+            body = inner.body
+        else:  # Lambda
+            args = inner.args
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+            ):
+                names.add(a.arg)
+            if args.vararg:
+                names.add(args.vararg.arg)
+            if args.kwarg:
+                names.add(args.kwarg.arg)
+            return names
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.stmt):
+                    names.update(stmt_defs(node))
+        return names
+
+    def visit(node: ast.AST, inside_nested: bool, shadowed: Set[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNCTION_NODES + (ast.Lambda,)):
+                visit(
+                    child, True, shadowed | local_names(child)
+                )
+            elif (
+                inside_nested
+                and isinstance(child, ast.Name)
+                and isinstance(child.ctx, ast.Load)
+                and child.id not in shadowed
+            ):
+                out.setdefault(child.id, []).append(child)
+                visit(child, inside_nested, shadowed)
+            else:
+                visit(child, inside_nested, shadowed)
+
+    visit(fn, False, set())
+    return out
+
+
+class FunctionDataflow:
+    """Statement CFG + reaching-definitions for one function body.
+
+    ``statements`` is the flattened list of every statement in ``fn``'s
+    body (compound statements included, nested defs NOT descended into);
+    ``succ[i]`` are the CFG successors of statement ``i``.
+    """
+
+    def __init__(self, fn: FunctionNode):
+        self.fn = fn
+        self.statements: List[ast.stmt] = []
+        self._index: Dict[int, int] = {}  # id(stmt) -> index
+        self.succ: Dict[int, Set[int]] = {}
+        self._defs: Dict[int, Set[str]] = {}
+        self._uses: Dict[int, List[Tuple[str, ast.AST]]] = {}
+        self._build(fn.body)
+        for i, stmt in enumerate(self.statements):
+            self._defs[i] = stmt_defs(stmt)
+            self._uses[i] = stmt_uses(stmt)
+
+    # -- CFG construction -----------------------------------------------------
+
+    def _add(self, stmt: ast.stmt) -> int:
+        idx = len(self.statements)
+        self.statements.append(stmt)
+        self._index[id(stmt)] = idx
+        self.succ[idx] = set()
+        return idx
+
+    def _link(self, frontier: Set[int], target: int):
+        for i in frontier:
+            self.succ[i].add(target)
+
+    def _build(self, body: List[ast.stmt]):
+        # ``frontier`` is the set of statement ids whose control falls
+        # through to the next statement in sequence.  ``breaks`` /
+        # ``continues`` collect loop-exit edges for the enclosing loop.
+        final = self._block(body, frontier={ENTRY}, breaks=None,
+                            continues=None, handlers=())
+        self.succ.setdefault(EXIT, set())
+        for i in final:
+            if i != ENTRY:
+                self.succ[i].add(EXIT)
+
+    def _block(
+        self,
+        body: List[ast.stmt],
+        frontier: Set[int],
+        breaks: Optional[Set[int]],
+        continues: Optional[Set[int]],
+        handlers: Tuple[int, ...],
+    ) -> Set[int]:
+        for stmt in body:
+            idx = self._add(stmt)
+            self._link(frontier - {ENTRY}, idx)
+            frontier = {idx}
+            # Any statement inside a try can jump to its handlers.
+            for h in handlers:
+                self.succ[idx].add(h)
+            if isinstance(stmt, ast.If):
+                then = self._block(
+                    stmt.body, {idx}, breaks, continues, handlers
+                )
+                if stmt.orelse:
+                    other = self._block(
+                        stmt.orelse, {idx}, breaks, continues, handlers
+                    )
+                    frontier = then | other
+                else:
+                    frontier = then | {idx}
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                inner_breaks: Set[int] = set()
+                inner_continues: Set[int] = set()
+                tail = self._block(
+                    stmt.body, {idx}, inner_breaks, inner_continues,
+                    handlers,
+                )
+                # Back edge: loop tail (and continues) re-enter the header.
+                for i in tail | inner_continues:
+                    self.succ[i].add(idx)
+                frontier = {idx} | inner_breaks
+                if stmt.orelse:
+                    # ``else`` runs on normal exit (header false) only;
+                    # a break jumps past it.
+                    else_tail = self._block(
+                        stmt.orelse, {idx}, breaks, continues, handlers
+                    )
+                    frontier = else_tail | inner_breaks
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                frontier = self._block(
+                    stmt.body, {idx}, breaks, continues, handlers
+                )
+            elif isinstance(stmt, ast.Try):
+                # Each handler gets a CFG node of its own (the
+                # ExceptHandler header, binding ``except E as name``)
+                # created up-front so body statements can edge to it.
+                entries: List[int] = []
+                for handler in stmt.handlers:
+                    h_idx = self._add(handler)
+                    entries.append(h_idx)
+                body_tail = self._block(
+                    stmt.body, {idx}, breaks, continues,
+                    handlers + tuple(entries),
+                )
+                h_tails: Set[int] = set()
+                for h_idx, handler in zip(entries, stmt.handlers):
+                    h_tails |= self._block(
+                        handler.body, {h_idx}, breaks, continues, handlers
+                    )
+                if stmt.orelse:
+                    body_tail = self._block(
+                        stmt.orelse, body_tail, breaks, continues, handlers
+                    )
+                frontier = body_tail | h_tails
+                if stmt.finalbody:
+                    frontier = self._block(
+                        stmt.finalbody, frontier, breaks, continues,
+                        handlers,
+                    )
+            elif isinstance(stmt, (ast.Return, ast.Raise)):
+                # Raise may still reach an enclosing handler (linked
+                # above); neither falls through.
+                frontier = set()
+            elif isinstance(stmt, ast.Break):
+                if breaks is not None:
+                    breaks.add(idx)
+                frontier = set()
+            elif isinstance(stmt, ast.Continue):
+                if continues is not None:
+                    continues.add(idx)
+                frontier = set()
+        return frontier
+
+    # -- queries --------------------------------------------------------------
+
+    def index_of(self, stmt: ast.AST) -> Optional[int]:
+        return self._index.get(id(stmt))
+
+    def defs_of(self, idx: int) -> Set[str]:
+        return self._defs.get(idx, set())
+
+    def uses_of(self, idx: int) -> List[Tuple[str, ast.AST]]:
+        return self._uses.get(idx, [])
+
+    def statement_for(self, node: ast.AST) -> Optional[ast.stmt]:
+        """The CFG statement lexically containing ``node`` (the node
+        itself when it is a tracked statement)."""
+        best: Optional[ast.stmt] = None
+        for stmt in self.statements:
+            if any(n is node for n in ast.walk(stmt)):
+                best = stmt  # innermost tracked stmt wins (walk order)
+        return best
+
+    def uses_after(
+        self, stmt: ast.AST, name: str
+    ) -> List[Tuple[ast.stmt, ast.AST]]:
+        """Reads of ``name`` reachable on some CFG path strictly after
+        ``stmt`` before any redefinition — i.e. reads that can observe
+        the value ``name`` holds as ``stmt`` executes.
+
+        Returns ``(reading_statement, name_node)`` pairs.  If ``stmt``
+        itself rebinds ``name`` (the ``pool = f(pool)`` donated-carry
+        idiom) there is nothing to find: the stale binding dies with the
+        statement.
+        """
+        start = self.index_of(stmt)
+        if start is None:
+            inner = self.statement_for(stmt)
+            if inner is None:
+                return []
+            start = self.index_of(inner)
+            if start is None:
+                return []
+        if name in self._defs.get(start, set()):
+            return []
+        out: List[Tuple[ast.stmt, ast.AST]] = []
+        seen: Set[int] = set()
+        work = list(self.succ.get(start, ()))
+        while work:
+            i = work.pop()
+            if i in seen or i in (ENTRY, EXIT):
+                continue
+            seen.add(i)
+            node_stmt = self.statements[i]
+            for use_name, node in self._uses.get(i, []):
+                if use_name == name:
+                    out.append((node_stmt, node))
+            if name in self._defs.get(i, set()):
+                continue  # killed on this path
+            work.extend(self.succ.get(i, ()))
+        out.sort(key=lambda pair: (
+            getattr(pair[1], "lineno", 0), getattr(pair[1], "col_offset", 0)
+        ))
+        return out
+
+    def reaching_defs(self) -> Dict[int, Set[Tuple[str, int]]]:
+        """Classic reaching definitions: for each statement index, the
+        set of ``(name, def_stmt_index)`` pairs that may reach its entry.
+        Function parameters reach as ``(param, ENTRY)``."""
+        params: Set[Tuple[str, int]] = set()
+        args = self.fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            params.add((a.arg, ENTRY))
+        if args.vararg:
+            params.add((args.vararg.arg, ENTRY))
+        if args.kwarg:
+            params.add((args.kwarg.arg, ENTRY))
+
+        preds: Dict[int, Set[int]] = {i: set() for i in self.succ}
+        for i, succs in self.succ.items():
+            for j in succs:
+                preds.setdefault(j, set()).add(i)
+
+        n = len(self.statements)
+        in_sets: Dict[int, Set[Tuple[str, int]]] = {
+            i: set() for i in range(n)
+        }
+        out_sets: Dict[int, Set[Tuple[str, int]]] = {
+            i: set() for i in range(n)
+        }
+        # Statements with no predecessor are entered from the function
+        # top (ENTRY edges are implicit): the parameters reach them.
+        changed = True
+        while changed:
+            changed = False
+            for i in range(n):
+                new_in: Set[Tuple[str, int]] = set()
+                if not preds.get(i):
+                    new_in |= params
+                for p in preds.get(i, ()):
+                    if p in (ENTRY, EXIT):
+                        continue
+                    new_in |= out_sets[p]
+                kills = self._defs.get(i, set())
+                new_out = {
+                    (nm, d) for (nm, d) in new_in if nm not in kills
+                } | {(nm, i) for nm in kills}
+                if new_in != in_sets[i] or new_out != out_sets[i]:
+                    in_sets[i] = new_in
+                    out_sets[i] = new_out
+                    changed = True
+        return in_sets
+
+    def unique_reaching_def(
+        self, stmt: ast.AST, name: str
+    ) -> Optional[ast.stmt]:
+        """The single definition of ``name`` reaching ``stmt``, or None
+        when zero or several defs (or a parameter) reach it — the "where
+        statically derivable" guard SHD002 leans on."""
+        idx = self.index_of(stmt)
+        if idx is None:
+            inner = self.statement_for(stmt)
+            idx = self.index_of(inner) if inner is not None else None
+        if idx is None:
+            return None
+        reaching = self.reaching_defs().get(idx, set())
+        sites = [d for (nm, d) in reaching if nm == name]
+        if len(sites) != 1 or sites[0] == ENTRY:
+            return None
+        return self.statements[sites[0]]

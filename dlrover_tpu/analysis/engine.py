@@ -87,6 +87,72 @@ class Report:
             sort_keys=True,
         )
 
+    def render_sarif(self) -> str:
+        """SARIF 2.1.0 — the PR-annotation interchange format GitHub /
+        Azure / VS Code all consume.  The driver advertises every
+        registered rule (stable ``ruleIndex`` by sorted id); each finding
+        becomes one ``result`` with a physical location."""
+        from dlrover_tpu.analysis.core import all_rules
+
+        rules = all_rules()
+        index_of = {rule.id: i for i, rule in enumerate(rules)}
+        sarif_rules = [
+            {
+                "id": rule.id,
+                "name": rule.name,
+                "shortDescription": {"text": rule.description},
+            }
+            for rule in rules
+        ]
+        results = []
+        for f in self.findings:
+            result = {
+                "ruleId": f.rule,
+                "level": "warning",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {
+                                "startLine": max(1, f.line),
+                                "startColumn": max(1, f.col),
+                            },
+                        }
+                    }
+                ],
+            }
+            if f.rule in index_of:
+                result["ruleIndex"] = index_of[f.rule]
+            results.append(result)
+        doc = {
+            "version": "2.1.0",
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "runs": [
+                {
+                    "tool": {
+                        "driver": {
+                            "name": "tracelint",
+                            "informationUri": (
+                                "https://github.com/intelligent-machine-"
+                                "learning/dlrover"
+                            ),
+                            "rules": sarif_rules,
+                        }
+                    },
+                    "columnKind": "utf16CodeUnits",
+                    "results": results,
+                }
+            ],
+        }
+        return json.dumps(doc, indent=2, sort_keys=True)
+
 
 def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
     for path in paths:
@@ -107,7 +173,7 @@ def load_baseline(path: str) -> Dict[str, str]:
     """baseline_key -> reason.  Entries are written by ``--write-baseline``
     and are expected to carry a human ``reason`` explaining why the finding
     is grandfathered rather than fixed."""
-    with open(path, "r", encoding="utf-8") as fh:
+    with open(path, encoding="utf-8") as fh:
         data = json.load(fh)
     out: Dict[str, str] = {}
     for entry in data.get("findings", []):
@@ -161,7 +227,7 @@ def run_paths(
         rel = os.path.relpath(os.path.abspath(file_path), root)
         rel = rel.replace(os.sep, "/")
         try:
-            with open(file_path, "r", encoding="utf-8") as fh:
+            with open(file_path, encoding="utf-8") as fh:
                 source = fh.read()
         except OSError as e:
             report.findings.append(Finding(

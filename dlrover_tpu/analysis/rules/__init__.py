@@ -2,9 +2,12 @@
 
 from dlrover_tpu.analysis.rules import (  # noqa: F401  (registration imports)
     compat,
+    donation,
     host_sync,
     logfmt,
     retry_loops,
+    seams,
+    sharding,
     threads,
     trace_purity,
 )

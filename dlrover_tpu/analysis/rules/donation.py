@@ -1,0 +1,255 @@
+"""DON001 + DON002: use of a buffer after it was donated to XLA.
+
+The TPU-only bug class behind these rules: ``donate_argnums`` /
+``donate_argnames`` tells XLA it may reuse the argument's device buffer
+for the output.  On a real TPU the donated buffer is *invalidated* — a
+later read raises (at best) or returns aliased garbage (at worst, under
+``--xla_...buffer_donor`` paths).  On the CPU backend jax frequently
+copies instead of aliasing, so the tier-1 suite cannot catch it: the
+code runs green on CPU and detonates on the first TPU mesh.  This repo
+leans on donation in exactly the hot paths — the serving slot-pool
+(``serving/decode.py`` donates the KV pool to ``insert``/``decode_step``)
+and the train-state carry (``trainer/train_lib.py`` donates the state to
+``step_jit``) — so the stale-read shape must stay extinct.
+
+**DON001** — a binding passed at a donated position of a jit-compiled
+callable is read again on some control-flow path after the call without
+being rebound.  The sanctioned idiom rebinds the result over the operand
+in the same statement (``pool = insert(pool, row, slot)`` /
+``self.cache = ...insert(self.cache, ...)``): the stale binding dies
+with the statement, and the dataflow engine treats it as killed.
+
+**DON002** — a donated binding is also captured by a nested function
+(closure).  The closure's cell keeps the name alive past the donation,
+so a later invocation reads the invalidated buffer even when the
+straight-line code never touches it again.  Rebinding the result over
+the operand is safe here too (the cell then holds the fresh value).
+
+Both rules resolve donating callables per file: names bound to
+``jax.jit(fn, donate_argnums=...)`` results (plain or ``self.attr``),
+functions decorated ``@partial(jax.jit, donate_argnums=...)``, and
+immediately-invoked ``jax.jit(fn, donate_argnums=...)(...)`` calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import dataflow, jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: Call names that produce a donation-capable compiled callable.
+JIT_CALLS: Set[str] = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Donor:
+    """Donation signature of one jit-compiled callable."""
+
+    positions: Tuple[int, ...]
+    argnames: Tuple[str, ...]
+
+
+def _const_ints(node: ast.AST) -> Tuple[int, ...]:
+    """Every int constant anywhere in ``node`` — covers a bare int, a
+    tuple/list, and conditional forms like ``(0,) if donate else ()``
+    (donation on *some* configuration is donation for lint purposes)."""
+    return tuple(
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, int)
+        and not isinstance(n.value, bool)
+    )
+
+
+def _const_strs(node: ast.AST) -> Tuple[str, ...]:
+    return tuple(
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    )
+
+
+def _donor_of_jit_call(call: ast.Call) -> Optional[Donor]:
+    """The :class:`Donor` a ``jax.jit(...)`` call defines, or None when
+    it does not donate.  Accepts the ``partial(jax.jit, ...)`` spelling
+    (decorator idiom) too."""
+    name = jaxast.call_name(call)
+    is_jit = jaxast.name_matches(name, JIT_CALLS)
+    if not is_jit and name in ("partial", "functools.partial"):
+        is_jit = any(
+            jaxast.name_matches(jaxast.dotted_name(a), JIT_CALLS)
+            for a in call.args
+        )
+    if not is_jit:
+        return None
+    positions: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            positions = _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            argnames = _const_strs(kw.value)
+    if not positions and not argnames:
+        return None
+    return Donor(positions=positions, argnames=argnames)
+
+
+def collect_donors(tree: ast.Module) -> Dict[str, Donor]:
+    """binding name -> donation signature, for every donating callable
+    bound in this module: ``x = jax.jit(f, donate_argnums=...)``,
+    ``self._x = ...`` (keyed ``"self._x"``), and functions decorated with
+    a donating ``partial(jax.jit, ...)`` / ``jax.jit(...)``."""
+    donors: Dict[str, Donor] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            donor = _donor_of_jit_call(node.value)
+            if donor is None:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    donors[target.id] = donor
+                elif isinstance(target, ast.Attribute):
+                    pseudo = dataflow.self_attr(target)
+                    if pseudo:
+                        donors[pseudo] = donor
+        elif isinstance(node, jaxast.FUNCTION_NODES):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    donor = _donor_of_jit_call(dec)
+                    if donor is not None:
+                        donors[node.name] = donor
+    return donors
+
+
+def _binding_of(arg: ast.AST) -> str:
+    """The tracked binding an argument expression reads: a plain name or
+    a ``self.attr`` chain; "" for anything else."""
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute):
+        return dataflow.self_attr(arg)
+    return ""
+
+
+def _donated_bindings(
+    call: ast.Call, donor: Donor
+) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for pos in donor.positions:
+        if 0 <= pos < len(call.args):
+            binding = _binding_of(call.args[pos])
+            if binding:
+                out.append((binding, call.args[pos]))
+    if donor.argnames:
+        for kw in call.keywords:
+            if kw.arg in donor.argnames:
+                binding = _binding_of(kw.value)
+                if binding:
+                    out.append((binding, kw.value))
+    return out
+
+
+def iter_donating_calls(
+    tree: ast.Module, fn: jaxast.FunctionNode
+) -> Iterator[Tuple[ast.Call, List[Tuple[str, ast.AST]]]]:
+    """Donating call sites in ``fn``'s own body with their donated
+    (binding, arg-node) pairs."""
+    donors = collect_donors(tree)
+    for node in jaxast.body_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        donor: Optional[Donor] = None
+        callee = jaxast.call_name(node)
+        if callee in donors:
+            donor = donors[callee]
+        elif isinstance(node.func, ast.Call):
+            # Immediately-invoked: jax.jit(f, donate_argnums=(0,))(x).
+            donor = _donor_of_jit_call(node.func)
+        if donor is None:
+            continue
+        bindings = _donated_bindings(node, donor)
+        if bindings:
+            yield node, bindings
+
+
+@register
+class UseAfterDonate(Rule):
+    id = "DON001"
+    name = "use-after-donate"
+    description = (
+        "binding read after being passed at a donated jit argument "
+        "position (XLA invalidates the buffer on TPU; rebind the result "
+        "over the operand, e.g. pool = step(pool, ...))"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn_name, fn in jaxast.iter_functions(ctx.tree):
+            calls = list(iter_donating_calls(ctx.tree, fn))
+            if not calls:
+                continue
+            df = dataflow.FunctionDataflow(fn)
+            for call, bindings in calls:
+                stmt = df.statement_for(call)
+                if stmt is None:
+                    continue
+                for binding, _arg in bindings:
+                    for _read_stmt, read in df.uses_after(stmt, binding):
+                        yield ctx.finding(
+                            self.id, read,
+                            f"{binding!r} is read after being donated to "
+                            f"{jaxast.call_name(call) or 'a jitted callable'}"
+                            f" at line {call.lineno}; on TPU the buffer "
+                            "is invalidated — rebind the result over the "
+                            f"operand ({binding} = ...) or drop the "
+                            "donation",
+                            symbol=f"{fn_name}:{binding}",
+                        )
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        # Dedup repeated reads of the same stale binding at the same
+        # line (tuple unpacking, f-strings) before the engine sees them.
+        seen: Set[Tuple[str, int, str]] = set()
+        for finding in super().run(ctx):
+            key = (finding.symbol, finding.line, finding.message)
+            if key not in seen:
+                seen.add(key)
+                yield finding
+
+
+@register
+class DonatedClosureCapture(Rule):
+    id = "DON002"
+    name = "donated-closure-capture"
+    description = (
+        "binding donated to a jitted callable is also captured by a "
+        "nested closure without being rebound (the closure cell keeps "
+        "the invalidated buffer reachable)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn_name, fn in jaxast.iter_functions(ctx.tree):
+            calls = list(iter_donating_calls(ctx.tree, fn))
+            if not calls:
+                continue
+            captured = dataflow.closure_reads(fn)
+            if not captured:
+                continue
+            df = dataflow.FunctionDataflow(fn)
+            for call, bindings in calls:
+                stmt = df.statement_for(call)
+                rebound = dataflow.stmt_defs(stmt) if stmt else set()
+                for binding, arg in bindings:
+                    if binding in captured and binding not in rebound:
+                        yield ctx.finding(
+                            self.id, arg,
+                            f"{binding!r} is donated here but also read "
+                            "by a nested closure (line "
+                            f"{captured[binding][0].lineno}); the "
+                            "closure will observe the invalidated "
+                            "buffer — rebind the result over "
+                            f"{binding!r} or pass it as an argument",
+                            symbol=f"{fn_name}:{binding}",
+                        )

@@ -1,0 +1,197 @@
+"""SEAM001: raw I/O in a fault-handling tier not routed through Faultline.
+
+PR 6 made failure a first-class input: every point where the real world
+can fail (an RPC send, a checkpoint write, an atomic rename) declares a
+named *seam* via ``faults.fire(...)`` so the deterministic fault planner
+can exercise it.  That only works if the convention holds — a raw
+``open(..., "w")`` or ``os.replace`` added to ``agent/`` without a
+``fire`` call is a failure path the chaos drills (resize, SDC, bitflip)
+can never reach, and the first time it breaks is in production.  This
+rule turns the convention into a checked property.
+
+A raw I/O call (write-mode ``open``, ``os.replace``/``os.rename``,
+``shutil.*``, ``socket.*`` connection constructors, ``urlopen``,
+``requests.*``) inside the fault-handling tiers (``agent/``,
+``master/``, ``checkpoint/``, ``data/``) fires unless its enclosing
+function also fires a *registered* seam — the seam registry is parsed
+from ``common/faults.py``'s ``KNOWN_SEAMS`` tuple, so inventing an
+unregistered seam name doesn't count as coverage.  Module-level raw I/O
+in those tiers always fires (there is no enclosing function to carry
+the seam).  ``common/faults.py`` itself and the analysis package are
+exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: Tiers where unseamed I/O hides from the fault drills (substring match,
+#: same idiom as RTY001's SWALLOW_SCOPES).
+SEAM_SCOPES: Tuple[str, ...] = (
+    "agent/", "master/", "checkpoint/", "data/",
+)
+
+#: Fallback registry when common/faults.py cannot be parsed (fixtures).
+FALLBACK_SEAMS: Tuple[str, ...] = (
+    "rpc.report", "rpc.get", "storage.write", "storage.read",
+    "saver.persist", "saver.flush", "backend.init", "coworker.fetch",
+    "preempt.notice", "rdzv.join", "sdc.flip", "serve.admit",
+)
+
+#: Dotted call names that are raw I/O regardless of arguments.
+RAW_IO_CALLS: Set[str] = {
+    "os.replace", "os.rename", "os.remove", "os.unlink",
+    "socket.socket", "socket.create_connection",
+    "urlopen", "urllib.request.urlopen", "request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.head",
+    "requests.delete", "requests.request",
+}
+
+#: ``shutil.<anything>`` is treated as raw I/O wholesale.
+SHUTIL_PREFIX = "shutil."
+
+#: open() modes that mutate: any of these chars in the mode string.
+_WRITE_MODE_CHARS = frozenset("wax+")
+
+_known_seams_cache: Optional[Set[str]] = None
+
+
+def known_seams() -> Set[str]:
+    """The Faultline seam registry, parsed from ``common/faults.py``'s
+    ``KNOWN_SEAMS`` tuple; :data:`FALLBACK_SEAMS` when unreadable."""
+    global _known_seams_cache
+    if _known_seams_cache is not None:
+        return _known_seams_cache
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    path = os.path.join(pkg_root, "common", "faults.py")
+    seams: Set[str] = set()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SEAMS"
+                for t in node.targets
+            ):
+                seams.update(
+                    n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                )
+    except (OSError, SyntaxError):
+        pass
+    _known_seams_cache = seams or set(FALLBACK_SEAMS)
+    return _known_seams_cache
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """True for ``open(path, "w"|"wb"|"a"|"r+"...)`` — a mode literal
+    containing a mutating char.  Mode-less ``open`` is a read."""
+    if jaxast.call_name(call) != "open":
+        return False
+    mode: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(
+        mode.value, str
+    ):
+        return False
+    return bool(_WRITE_MODE_CHARS & set(mode.value))
+
+
+def raw_io_kind(call: ast.Call) -> str:
+    """Which raw-I/O family ``call`` belongs to, or "" if none."""
+    if _open_write_mode(call):
+        return "open-for-write"
+    name = jaxast.call_name(call)
+    if not name:
+        return ""
+    if name in RAW_IO_CALLS:
+        return name
+    if name.startswith(SHUTIL_PREFIX):
+        return name
+    return ""
+
+
+def fired_seams(fn: jaxast.FunctionNode) -> Set[str]:
+    """Registered seam names fired anywhere in ``fn`` (including nested
+    helpers — a ``with``-wrapper closure firing the seam still covers
+    the raw call it wraps)."""
+    registry = known_seams()
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = jaxast.call_name(node)
+        if name != "fire" and not name.endswith(".fire"):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and (
+            isinstance(node.args[0].value, str)
+        ):
+            seam = node.args[0].value
+            if seam in registry:
+                out.add(seam)
+    return out
+
+
+@register
+class UnseamedRawIO(Rule):
+    id = "SEAM001"
+    name = "unseamed-raw-io"
+    description = (
+        "raw I/O in a fault-handling tier (agent/master/checkpoint/"
+        "data) with no registered Faultline seam fired in the enclosing "
+        "function; the fault drills cannot reach this failure path"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.rel_path.replace("\\", "/")
+        if not any(scope in path for scope in SEAM_SCOPES):
+            return
+        if path.endswith("common/faults.py"):
+            return
+        # Raw I/O directly covered: call -> enclosing function.
+        enclosing: Dict[int, Optional[jaxast.FunctionNode]] = {}
+        fn_of: List[Tuple[ast.Call, str, Optional[jaxast.FunctionNode]]] = []
+        seen: Set[int] = set()
+        for _fn_name, fn in jaxast.iter_functions(ctx.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and id(node) not in seen:
+                    seen.add(id(node))
+                    kind = raw_io_kind(node)
+                    if kind:
+                        fn_of.append((node, kind, fn))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and id(node) not in seen:
+                seen.add(id(node))
+                kind = raw_io_kind(node)
+                if kind:
+                    fn_of.append((node, kind, None))
+        seam_cache: Dict[int, Set[str]] = {}
+        for call, kind, fn in fn_of:
+            if fn is not None:
+                if id(fn) not in seam_cache:
+                    seam_cache[id(fn)] = fired_seams(fn)
+                if seam_cache[id(fn)]:
+                    continue
+                where = f"in {fn.name}()"
+            else:
+                where = "at module level"
+            yield ctx.finding(
+                self.id, call,
+                f"raw I/O ({kind}) {where} with no registered Faultline "
+                "seam fired; wrap it in faults.fire(\"storage.write\"/"
+                "\"storage.read\"/...) so injection drills cover this "
+                "failure path",
+                symbol=f"{getattr(fn, 'name', '<module>')}:{kind}",
+            )

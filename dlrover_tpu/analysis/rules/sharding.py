@@ -1,0 +1,330 @@
+"""SHD001 + SHD002: PartitionSpec drift the CPU tier-1 suite cannot see.
+
+Hand-written ``PartitionSpec``s are stringly-typed: a spec naming an
+axis that no constructed mesh defines ("dp" where the mesh says "data",
+a typo'd "tesnor") is *silently replicated* by GSPMD on the
+single-device CPU meshes the tests run on — and only explodes (or, far
+worse, silently changes the memory/comm layout) on a real multi-chip
+TPU mesh where the axis name is load-bearing.  Same story for a spec
+whose rank exceeds the array's: jax raises only when the constraint is
+actually applied on a mesh that shards that dimension.
+
+**SHD001** — a string axis in a ``PartitionSpec(...)`` (any import
+alias, ``P`` included) that is neither one of the repo's canonical mesh
+axes (parsed from ``runtime/mesh.py``'s ``*_AXIS`` constants and
+``MESH_AXES``) nor an axis of a mesh constructed *in the same file*
+(``Mesh(devices, ("d",))`` makes "d" legal there).  Module-level string
+constants are resolved, so ``P(ROW_AXIS)`` checks the constant's value.
+
+**SHD002** — ``with_sharding_constraint(x, ...PartitionSpec(...))``
+where the spec has more entries than ``x``'s statically-derivable rank
+(``x`` built by ``jnp.zeros/ones/empty/full`` with a literal shape, or
+``arange``; resolution goes through the dataflow engine's unique
+reaching definition, so anything ambiguous stays silent).
+
+Both rules are per-file like the rest of tracelint; the canonical-axes
+registry is the one cross-file fact, read from the shipped
+``runtime/mesh.py`` source the same way SEAM001 reads the Faultline
+registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import dataflow, jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+
+#: Fallback when runtime/mesh.py cannot be parsed (fixture trees): the
+#: axis-layout policy documented there.
+FALLBACK_AXES: Tuple[str, ...] = (
+    "data", "fsdp", "pipe", "expert", "seq", "tensor",
+)
+
+MESH_CALLS: Set[str] = {"Mesh", "jax.sharding.Mesh", "sharding.Mesh"}
+CONSTRAINT_CALLS: Set[str] = {
+    "with_sharding_constraint",
+    "lax.with_sharding_constraint",
+    "jax.lax.with_sharding_constraint",
+}
+#: Array constructors whose rank is statically derivable from a literal
+#: shape argument.
+SHAPE_CTORS: Set[str] = {
+    "jnp.zeros", "jnp.ones", "jnp.empty", "jnp.full",
+    "np.zeros", "np.ones", "np.empty", "np.full",
+    "numpy.zeros", "jax.numpy.zeros", "jax.numpy.ones",
+}
+RANK1_CTORS: Set[str] = {"jnp.arange", "np.arange", "numpy.arange"}
+
+_canonical_axes_cache: Optional[Set[str]] = None
+
+
+def canonical_axes() -> Set[str]:
+    """The repo's mesh axis names, parsed from ``runtime/mesh.py``
+    (``*_AXIS = "..."`` constants plus string entries of ``MESH_AXES``);
+    :data:`FALLBACK_AXES` when the source is unreadable."""
+    global _canonical_axes_cache
+    if _canonical_axes_cache is not None:
+        return _canonical_axes_cache
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    path = os.path.join(pkg_root, "runtime", "mesh.py")
+    axes: Set[str] = set()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        consts = module_str_constants(tree)
+        axes.update(
+            v for name, v in consts.items() if name.endswith("_AXIS")
+        )
+        for node in tree.body:
+            if (
+                isinstance(node, (ast.Assign, ast.AnnAssign))
+                and any(
+                    isinstance(t, ast.Name) and t.id == "MESH_AXES"
+                    for t in (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                )
+                and node.value is not None
+            ):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Constant) and isinstance(
+                        n.value, str
+                    ):
+                        axes.add(n.value)
+                    elif isinstance(n, ast.Name) and n.id in consts:
+                        axes.add(consts[n.id])
+    except (OSError, SyntaxError):
+        pass
+    _canonical_axes_cache = axes or set(FALLBACK_AXES)
+    return _canonical_axes_cache
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if (
+            value is not None
+            and isinstance(value, ast.Constant)
+            and isinstance(value.value, str)
+        ):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = value.value
+    return out
+
+
+def spec_aliases(tree: ast.Module) -> Set[str]:
+    """Call names that construct a PartitionSpec in this file: the
+    canonical dotted forms plus whatever the file imports it as."""
+    aliases: Set[str] = {"PartitionSpec", "jax.sharding.PartitionSpec",
+                         "sharding.PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def file_mesh_axes(tree: ast.Module) -> Set[str]:
+    """Axis names of every mesh constructed in this file: string entries
+    (or resolvable constants) of ``Mesh(devices, <axes>)`` second
+    positional / ``axis_names=`` argument."""
+    consts = module_str_constants(tree)
+    # Module-level tuple-of-strings assignments, for Mesh(dev, AXES).
+    tuple_consts: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.Tuple, ast.List)
+        ):
+            strs = {
+                n.value for n in ast.walk(node.value)
+                if isinstance(n, ast.Constant)
+                and isinstance(n.value, str)
+            }
+            if strs:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tuple_consts[t.id] = strs
+    axes: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not jaxast.name_matches(jaxast.call_name(node), MESH_CALLS):
+            continue
+        axis_args: List[ast.AST] = []
+        if len(node.args) >= 2:
+            axis_args.append(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                axis_args.append(kw.value)
+        for arg in axis_args:
+            if isinstance(arg, ast.Name):
+                axes.update(tuple_consts.get(arg.id, set()))
+                if arg.id in consts:
+                    axes.add(consts[arg.id])
+                continue
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Constant) and isinstance(
+                    n.value, str
+                ):
+                    axes.add(n.value)
+                elif isinstance(n, ast.Name) and n.id in consts:
+                    axes.add(consts[n.id])
+    return axes
+
+
+def spec_entries(
+    call: ast.Call, consts: Dict[str, str]
+) -> List[Tuple[str, ast.AST]]:
+    """Resolvable string axes named by one PartitionSpec call: constant
+    entries, tuple-of-constant entries, and module-constant names.
+    Unresolvable entries (starred args, attribute chains) are skipped —
+    the linter approximation."""
+    out: List[Tuple[str, ast.AST]] = []
+    for arg in call.args:
+        entries = (
+            list(arg.elts)
+            if isinstance(arg, (ast.Tuple, ast.List))
+            else [arg]
+        )
+        for entry in entries:
+            if isinstance(entry, ast.Constant) and isinstance(
+                entry.value, str
+            ):
+                out.append((entry.value, entry))
+            elif isinstance(entry, ast.Name) and entry.id in consts:
+                out.append((consts[entry.id], entry))
+    return out
+
+
+@register
+class ShardingSpecDrift(Rule):
+    id = "SHD001"
+    name = "sharding-spec-drift"
+    description = (
+        "PartitionSpec names a mesh axis no constructed mesh defines "
+        "(GSPMD silently replicates on CPU test meshes; the layout "
+        "breaks only on a real TPU mesh)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        aliases = spec_aliases(ctx.tree)
+        allowed = canonical_axes() | file_mesh_axes(ctx.tree)
+        consts = module_str_constants(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if jaxast.call_name(node) not in aliases:
+                continue
+            for axis, entry in spec_entries(node, consts):
+                if axis not in allowed:
+                    yield ctx.finding(
+                        self.id, entry,
+                        f"PartitionSpec axis {axis!r} is not defined by "
+                        "any constructed mesh (known axes: "
+                        f"{', '.join(sorted(allowed))}); a misspelled "
+                        "axis silently replicates instead of sharding",
+                        symbol=f"axis:{axis}",
+                    )
+
+
+@register
+class ShardingRankOverflow(Rule):
+    id = "SHD002"
+    name = "sharding-rank-overflow"
+    description = (
+        "with_sharding_constraint spec has more dimensions than the "
+        "constrained array's statically-known rank"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        aliases = spec_aliases(ctx.tree)
+        for fn_name, fn in jaxast.iter_functions(ctx.tree):
+            df: Optional[dataflow.FunctionDataflow] = None
+            for node in jaxast.body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not jaxast.name_matches(
+                    jaxast.call_name(node), CONSTRAINT_CALLS
+                ):
+                    continue
+                if not node.args:
+                    continue
+                spec_call = self._spec_call(node, aliases)
+                if spec_call is None or not spec_call.args:
+                    continue
+                spec_rank = len(spec_call.args)
+                if df is None:
+                    df = dataflow.FunctionDataflow(fn)
+                rank = self._array_rank(node.args[0], df, node)
+                if rank is not None and spec_rank > rank:
+                    yield ctx.finding(
+                        self.id, spec_call,
+                        f"spec has {spec_rank} entries but the "
+                        f"constrained array is rank {rank}; "
+                        "with_sharding_constraint raises on a real mesh",
+                        symbol=f"{fn_name}:rank",
+                    )
+
+    @staticmethod
+    def _spec_call(
+        constraint: ast.Call, aliases: Set[str]
+    ) -> Optional[ast.Call]:
+        if len(constraint.args) < 2:
+            return None
+        for n in ast.walk(constraint.args[1]):
+            if isinstance(n, ast.Call) and jaxast.call_name(n) in aliases:
+                return n
+        return None
+
+    @staticmethod
+    def _ctor_rank(value: ast.AST) -> Optional[int]:
+        if not isinstance(value, ast.Call):
+            return None
+        name = jaxast.call_name(value)
+        if jaxast.name_matches(name, RANK1_CTORS):
+            return 1
+        if not jaxast.name_matches(name, SHAPE_CTORS) or not value.args:
+            return None
+        shape = value.args[0]
+        if isinstance(shape, (ast.Tuple, ast.List)):
+            return len(shape.elts)
+        if isinstance(shape, ast.Constant) and isinstance(
+            shape.value, int
+        ):
+            return 1
+        return None
+
+    def _array_rank(
+        self,
+        arr: ast.AST,
+        df: dataflow.FunctionDataflow,
+        at: ast.AST,
+    ) -> Optional[int]:
+        direct = self._ctor_rank(arr)
+        if direct is not None:
+            return direct
+        if isinstance(arr, ast.Name):
+            def_stmt = df.unique_reaching_def(at, arr.id)
+            if (
+                isinstance(def_stmt, ast.Assign)
+                and len(def_stmt.targets) == 1
+                and isinstance(def_stmt.targets[0], ast.Name)
+            ):
+                return self._ctor_rank(def_stmt.value)
+        return None

@@ -32,7 +32,8 @@ injected failures instead of blaming the job.
 Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``rpc.report``, ``rpc.get``, ``storage.write``, ``storage.read``,
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
-``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``.
+``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``,
+``tpu.api``.
 """
 
 from __future__ import annotations
@@ -74,6 +75,11 @@ KNOWN_SEAMS = (
     # the engine's RetryPolicy (error kinds are retried with backoff;
     # delay kinds stall admission — modeling a slow/flaky front door).
     "serve.admit",
+    # Cloud control-plane seam: every GCE metadata / TPU REST call in
+    # master/tpu_api.py (token fetch, node create/delete/poll).  A fired
+    # error surfaces as the same CloudError/degrade path a flaky API
+    # produces, so launcher retry logic is drillable without GCP.
+    "tpu.api",
 )
 
 

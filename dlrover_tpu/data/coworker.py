@@ -284,7 +284,7 @@ class CoworkerDataLoader:
                             f"{self.stall_timeout_s:.0f}s with "
                             f"{sum(p.is_alive() for p in self._procs)} "
                             "live workers (deadlocked child?)"
-                        )
+                        ) from None
                     continue
                 if slot == -1:
                     raise RuntimeError(

@@ -16,6 +16,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -57,11 +58,16 @@ class TextShardReader:
                 offsets.append(offsets[-1] + len(line))
         arr = np.asarray(offsets, np.int64)
         try:
+            # Seam: a fired fault exercises the uncached-index path (the
+            # offsets array is rebuilt per process instead of mmapped).
+            faults.fire(
+                "storage.write", path=os.path.basename(self._index_path)
+            )
             tmp = self._index_path + f".tmp{os.getpid()}"
             np.save(tmp, arr)
             os.replace(tmp + ".npy" if not tmp.endswith(".npy") else tmp,
                        self._index_path)
-        except OSError as e:
+        except (OSError, faults.FaultInjected) as e:
             logger.warning("could not cache text index: %s", e)
         return arr
 

@@ -23,6 +23,7 @@ import os
 import time
 from typing import Dict, List
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -72,6 +73,9 @@ class BrainService:
         self._records.append(record)
         tmp = self.history_path + ".tmp"
         os.makedirs(os.path.dirname(self.history_path) or ".", exist_ok=True)
+        faults.fire(
+            "storage.write", path=os.path.basename(self.history_path)
+        )
         with open(tmp, "w") as f:
             json.dump(
                 [dataclasses.asdict(r) for r in self._records[-1000:]], f

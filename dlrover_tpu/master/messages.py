@@ -388,7 +388,9 @@ def safe_loads(data: bytes):
 
 def free_port(start: int = 20000, end: int = 40000) -> int:
     for port in range(start, end, 7):
-        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        # Local ephemeral-port probe (bind + close, no remote I/O);
+        # nothing a fault drill could meaningfully break here.
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:  # tracelint: disable=SEAM001
             try:
                 s.bind(("", port))
                 return port

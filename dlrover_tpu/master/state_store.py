@@ -19,6 +19,7 @@ import os
 import time
 from typing import Optional
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -72,6 +73,7 @@ class MasterStateStore:
     def save(self, master):
         tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        faults.fire("storage.write", path=os.path.basename(self.path))
         with open(tmp, "w") as f:
             json.dump(self.capture(master), f)
         os.replace(tmp, self.path)

@@ -38,6 +38,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.cloud_launcher import CloudError, TpuVmClient, TpuVmState
 
@@ -135,6 +136,7 @@ class TpuVmHttpClient(TpuVmClient):
     # -- auth / metadata ---------------------------------------------------
 
     def _metadata_get(self, path: str) -> str:
+        faults.fire("tpu.api", path=path)
         req = urllib.request.Request(
             self.metadata_host + path,
             headers={"Metadata-Flavor": "Google"},
@@ -149,7 +151,7 @@ class TpuVmHttpClient(TpuVmClient):
         )
         try:
             return self._metadata_get(prefix + name)
-        except (urllib.error.URLError, OSError):
+        except (urllib.error.URLError, OSError, faults.FaultInjected):
             return ""
 
     def _zone_from_metadata(self) -> str:
@@ -157,7 +159,7 @@ class TpuVmHttpClient(TpuVmClient):
             # "projects/<num>/zones/<zone>"
             full = self._metadata_get("/computeMetadata/v1/instance/zone")
             return full.rsplit("/", 1)[-1]
-        except (urllib.error.URLError, OSError):
+        except (urllib.error.URLError, OSError, faults.FaultInjected):
             return ""
 
     def _access_token(self) -> str:
@@ -187,11 +189,14 @@ class TpuVmHttpClient(TpuVmClient):
         data = json.dumps(body).encode() if body is not None else None
         try:
             token = self._access_token()
-        except (urllib.error.URLError, OSError, KeyError, ValueError) as e:
+        except (urllib.error.URLError, OSError, KeyError, ValueError,
+                faults.FaultInjected) as e:
             # The TpuVmClient contract is CloudError on ANY API failure —
             # a raw metadata-server exception would kill the launcher's
             # creator thread instead of being retried.
-            raise CloudError(f"UNAUTHENTICATED: token fetch failed: {e}")
+            raise CloudError(
+                f"UNAUTHENTICATED: token fetch failed: {e}"
+            ) from e
         req = urllib.request.Request(
             url, data=data, method=method,
             headers={
@@ -200,6 +205,7 @@ class TpuVmHttpClient(TpuVmClient):
             },
         )
         try:
+            faults.fire("tpu.api", path=path)
             with urllib.request.urlopen(
                 req, timeout=self.REQUEST_TIMEOUT_S
             ) as resp:
@@ -212,9 +218,12 @@ class TpuVmHttpClient(TpuVmClient):
                 status = json.loads(detail)["error"].get("status", status)
             except (ValueError, KeyError, TypeError):
                 pass
-            raise CloudError(f"{status}: {method} {path}: {detail[:500]}")
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise CloudError(f"UNAVAILABLE: {method} {path}: {e}")
+            raise CloudError(
+                f"{status}: {method} {path}: {detail[:500]}"
+            ) from e
+        except (urllib.error.URLError, OSError, TimeoutError,
+                faults.FaultInjected) as e:
+            raise CloudError(f"UNAVAILABLE: {method} {path}: {e}") from e
 
     # -- TpuVmClient -------------------------------------------------------
 

@@ -12,7 +12,7 @@ hand-inserts grad all-reduces).
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,13 +31,14 @@ class WSAMConfig(NamedTuple):
 def make_wsam_step(
     loss_fn: Callable,
     base_tx: optax.GradientTransformation,
-    config: WSAMConfig = WSAMConfig(),
+    config: Optional[WSAMConfig] = None,
 ) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
     ``loss_fn(params, *batch) -> scalar``.  Wrap the returned step in
     ``jax.jit`` (or build it into a sharded step) — it is pure.
     """
+    config = config if config is not None else WSAMConfig()
     alpha = config.gamma / (1.0 - config.gamma)
 
     def step(params, opt_state, *batch):

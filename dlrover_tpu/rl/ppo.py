@@ -120,10 +120,11 @@ class PPOTrainer:
         self,
         model_config: TransformerConfig,
         reward_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
-        config: PPOConfig = PPOConfig(),
+        config: Optional[PPOConfig] = None,
         rng: Optional[jax.Array] = None,
         engine=None,
     ):
+        config = config if config is not None else PPOConfig()
         self.config = config
         self.model_config = model_config
         if config.use_kv_cache and model_config.pipeline_stages > 1:

@@ -1,0 +1,361 @@
+"""Unit tests for the tracelint dataflow engine.
+
+Exercises :mod:`dlrover_tpu.analysis.dataflow` directly — CFG shape and
+reaching-definition queries over branches, loops, tuple unpacking, and
+closure capture — independent of any lint rule, so a rule regression and
+an engine regression show up as different failures.
+"""
+
+import ast
+import textwrap
+
+from dlrover_tpu.analysis import dataflow
+from dlrover_tpu.analysis.dataflow import (
+    ENTRY,
+    FunctionDataflow,
+    closure_reads,
+    stmt_defs,
+    stmt_uses,
+)
+
+
+def _fn(source):
+    tree = ast.parse(textwrap.dedent(source))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    raise AssertionError("no function in fixture")
+
+
+def _df(source):
+    return FunctionDataflow(_fn(source))
+
+
+def _stmt_at(df, lineno):
+    for stmt in df.statements:
+        if getattr(stmt, "lineno", None) == lineno:
+            return stmt
+    raise AssertionError(f"no CFG statement at line {lineno}")
+
+
+# -- stmt_defs / stmt_uses ------------------------------------------------
+
+
+def test_tuple_unpacking_defines_every_target():
+    stmt = ast.parse("a, (b, *rest) = pair").body[0]
+    assert stmt_defs(stmt) == {"a", "b", "rest"}
+
+
+def test_self_attr_assignment_is_a_pseudo_binding():
+    stmt = ast.parse("self.cache = new").body[0]
+    assert stmt_defs(stmt) == {"self.cache"}
+    reads = {name for name, _ in stmt_uses(stmt)}
+    assert "new" in reads
+    assert "self.cache" not in reads  # store, not load
+
+
+def test_subscript_store_is_not_a_kill():
+    stmt = ast.parse("pool[i] = row").body[0]
+    assert stmt_defs(stmt) == set()
+    reads = {name for name, _ in stmt_uses(stmt)}
+    # Writing pool[i] still reads (and mutates) the pool binding.
+    assert "pool" in reads
+
+
+def test_augassign_both_kills_and_uses():
+    stmt = ast.parse("total += x").body[0]
+    assert stmt_defs(stmt) == {"total"}
+
+
+def test_walrus_target_counts_as_def():
+    stmt = ast.parse("y = (n := f()) + 1").body[0]
+    assert stmt_defs(stmt) == {"y", "n"}
+
+
+def test_compound_header_uses_only():
+    # A for statement's own uses are its header (iter), not its body.
+    stmt = ast.parse("for i in items:\n    consume(state)").body[0]
+    reads = {name for name, _ in stmt_uses(stmt)}
+    assert reads == {"items"}
+    assert stmt_defs(stmt) == {"i"}
+
+
+# -- uses_after: branches -------------------------------------------------
+
+BRANCHY = """
+def f(state, batch):
+    out = step(state, batch)
+    if flag():
+        report(state)
+    else:
+        state = fresh()
+    return state
+"""
+
+
+def test_uses_after_sees_read_on_one_branch():
+    df = _df(BRANCHY)
+    donate = _stmt_at(df, 3)  # out = step(state, batch)
+    uses = df.uses_after(donate, "state")
+    lines = sorted(node.lineno for _, node in uses)
+    # Line 5 (report) reads the stale value; line 7 rebinds; line 8's
+    # read is reachable without redefinition via the then-branch.
+    assert 5 in lines and 8 in lines
+    assert 7 not in lines
+
+
+def test_uses_after_stops_at_rebinding_on_every_path():
+    df = _df(
+        """
+        def f(state):
+            out = step(state)
+            state = fresh()
+            return state
+        """
+    )
+    donate = _stmt_at(df, 3)
+    assert df.uses_after(donate, "state") == []
+
+
+def test_rebinding_statement_itself_kills():
+    # pool = insert(pool, ...) — the donated-carry idiom: the stale
+    # binding dies with the statement, so nothing can observe it.
+    df = _df(
+        """
+        def f(pool, row):
+            pool = insert(pool, row)
+            return pool
+        """
+    )
+    donate = _stmt_at(df, 3)
+    assert df.uses_after(donate, "pool") == []
+
+
+# -- uses_after: loops ----------------------------------------------------
+
+
+def test_loop_back_edge_reaches_own_statement():
+    # Without a rebind, the next iteration's call re-reads the stale
+    # binding — the back edge must surface it.
+    df = _df(
+        """
+        def f(state, batches):
+            for batch in batches:
+                out = step(state, batch)
+            return out
+        """
+    )
+    donate = _stmt_at(df, 4)
+    uses = df.uses_after(donate, "state")
+    assert [node.lineno for _, node in uses] == [4]
+
+
+def test_loop_carry_rebind_is_clean():
+    df = _df(
+        """
+        def f(state, batches):
+            for batch in batches:
+                state = step(state, batch)
+            return finalize(state)
+        """
+    )
+    donate = _stmt_at(df, 4)
+    # The statement rebinds state: immediate kill, nothing after.
+    assert df.uses_after(donate, "state") == []
+
+
+def test_while_loop_read_after_call():
+    df = _df(
+        """
+        def f(state):
+            while more():
+                out = step(state)
+                log(state)
+            return out
+        """
+    )
+    donate = _stmt_at(df, 4)
+    uses = df.uses_after(donate, "state")
+    lines = sorted({node.lineno for _, node in uses})
+    # log(state) on line 5, and line 4 again via the back edge.
+    assert lines == [4, 5]
+
+
+def test_break_skips_loop_else():
+    df = _df(
+        """
+        def f(xs, state):
+            for x in xs:
+                if bad(x):
+                    break
+                state = step(state, x)
+            else:
+                audit(state)
+            return state
+        """
+    )
+    header = _stmt_at(df, 3)
+    idx = df.index_of(header)
+    # The break statement's successors must not include the else body.
+    brk = _stmt_at(df, 5)
+    brk_succs = df.succ[df.index_of(brk)]
+    else_stmt = _stmt_at(df, 8)
+    assert df.index_of(else_stmt) not in brk_succs
+    assert idx is not None
+
+
+# -- reaching definitions / unique_reaching_def ---------------------------
+
+
+def test_unique_reaching_def_straight_line():
+    df = _df(
+        """
+        def f():
+            x = make()
+            use(x)
+        """
+    )
+    use = _stmt_at(df, 4)
+    d = df.unique_reaching_def(use, "x")
+    assert d is not None and d.lineno == 3
+
+
+def test_unique_reaching_def_ambiguous_over_branch():
+    df = _df(
+        """
+        def f(flag):
+            if flag:
+                x = a()
+            else:
+                x = b()
+            use(x)
+        """
+    )
+    use = _stmt_at(df, 7)
+    assert df.unique_reaching_def(use, "x") is None
+
+
+def test_parameter_reaches_as_entry():
+    df = _df(
+        """
+        def f(x):
+            use(x)
+        """
+    )
+    use = _stmt_at(df, 3)
+    reaching = df.reaching_defs()[df.index_of(use)]
+    assert ("x", ENTRY) in reaching
+    # ENTRY defs are deliberately not "unique" — rank is unknowable.
+    assert df.unique_reaching_def(use, "x") is None
+
+
+def test_tuple_unpacking_reaches_each_name():
+    df = _df(
+        """
+        def f(pair):
+            a, b = pair
+            use(a)
+            use(b)
+        """
+    )
+    for line, name in ((4, "a"), (5, "b")):
+        use = _stmt_at(df, line)
+        d = df.unique_reaching_def(use, name)
+        assert d is not None and d.lineno == 3
+
+
+def test_query_accepts_non_statement_node():
+    # Rules pass Call/Name nodes; the engine maps them to the enclosing
+    # CFG statement via statement_for.
+    fn = _fn(
+        """
+        def f():
+            x = make()
+            use(x)
+        """
+    )
+    df = FunctionDataflow(fn)
+    call = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and getattr(
+            node.func, "id", ""
+        ) == "use":
+            call = node
+    assert call is not None
+    d = df.unique_reaching_def(call, "x")
+    assert d is not None and d.lineno == 3
+
+
+def test_try_handler_sees_body_defs_may_be_partial():
+    df = _df(
+        """
+        def f():
+            try:
+                x = risky()
+            except ValueError as e:
+                x = fallback(e)
+            use(x)
+        """
+    )
+    use = _stmt_at(df, 7)
+    # Both the body def and the handler def may reach: not unique.
+    assert df.unique_reaching_def(use, "x") is None
+
+
+# -- closure capture ------------------------------------------------------
+
+
+def test_closure_reads_reports_captured_name():
+    fn = _fn(
+        """
+        def outer(pool):
+            def hit(row):
+                return lookup(pool, row)
+            return hit
+        """
+    )
+    captured = closure_reads(fn)
+    assert "pool" in captured
+    assert all(isinstance(n, ast.Name) for n in captured["pool"])
+
+
+def test_closure_reads_skips_shadowed_names():
+    fn = _fn(
+        """
+        def outer(pool):
+            def rebuild(pool):
+                return refresh(pool)
+            return rebuild
+        """
+    )
+    assert "pool" not in closure_reads(fn)
+
+
+def test_closure_reads_sees_lambda_capture():
+    fn = _fn(
+        """
+        def outer(state):
+            return lambda batch: step(state, batch)
+        """
+    )
+    assert "state" in closure_reads(fn)
+
+
+def test_closure_reads_skips_locally_assigned():
+    fn = _fn(
+        """
+        def outer():
+            def worker():
+                state = fresh()
+                return step(state)
+            return worker
+        """
+    )
+    assert "state" not in closure_reads(fn)
+
+
+def test_self_attr_helper():
+    node = ast.parse("self.cache", mode="eval").body
+    assert dataflow.self_attr(node) == "self.cache"
+    other = ast.parse("obj.cache", mode="eval").body
+    assert dataflow.self_attr(other) == ""

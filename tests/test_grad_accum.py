@@ -35,9 +35,8 @@ def _make_batch(batch=32, seq=16, vocab=256, seed=0):
 
 
 def _build(grad_accum=1, accum_dtype="float32", reduce_quant="none",
-           optimizer="sgd", batch=32, seq=16,
-           parallel=ParallelConfig(data=4, fsdp=2)):
-    mesh = build_mesh(parallel)
+           optimizer="sgd", batch=32, seq=16, parallel=None):
+    mesh = build_mesh(parallel or ParallelConfig(data=4, fsdp=2))
     model = TransformerLM(TINY)
     opt = train_lib.make_optimizer(optimizer, learning_rate=1e-2)
     return train_lib.build_sharded_train(

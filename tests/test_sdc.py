@@ -60,8 +60,8 @@ def _make_batch(batch=32, seq=16, vocab=256, seed=0):
 
 
 def _build(zero1=False, grad_accum=1, reduce_quant="none",
-           batch=32, seq=16, parallel=ParallelConfig(data=4, fsdp=2)):
-    mesh = build_mesh(parallel)
+           batch=32, seq=16, parallel=None):
+    mesh = build_mesh(parallel or ParallelConfig(data=4, fsdp=2))
     model = TransformerLM(TINY)
     opt = train_lib.make_optimizer("sgd", learning_rate=1e-2)
     return train_lib.build_sharded_train(

@@ -88,6 +88,71 @@ def test_tracelint_json_smoke(tmp_path, cpu_child_env):
     assert payload["exit_code"] == 0
 
 
+def test_tracelint_sarif_smoke(tmp_path, cpu_child_env):
+    """``tracelint --format sarif`` over a dirty fixture: exit 1 and a
+    valid SARIF 2.1.0 document whose ruleIndex entries agree with the
+    advertised driver rules."""
+    (tmp_path / "bad.py").write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        'SPEC = P("dp", "tesnor")\n'
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         str(tmp_path), "--root", str(tmp_path), "--no-baseline",
+         "--format", "sarif"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tracelint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert len(rule_ids) >= 12
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    assert run["results"], "dirty fixture must produce results"
+    for result in run["results"]:
+        assert rule_ids[result["ruleIndex"]] == result["ruleId"]
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "bad.py"
+        assert loc["region"]["startLine"] >= 1
+    assert any(r["ruleId"] == "SHD001" for r in run["results"])
+
+
+def test_serve_bench_gate_predicate():
+    """The serve_bench ok gate is a pure predicate: rc 1 exactly when a
+    check fails, and the failed check is named."""
+    tool = _load_module(
+        os.path.join(REPO, "tools", "serve_bench.py"), "_serve_bench"
+    )
+    continuous = {
+        "requests": 8, "tokens": 100, "tokens_per_s": 50.0,
+        "p95_s": 0.5, "aot_s": 1.2,
+    }
+    static = {
+        "requests": 8, "tokens": 100, "tokens_per_s": 30.0,
+        "p95_s": 0.9, "aot_s": 0.0,
+    }
+    ledger = {"cached_compiles": 1}
+    ok, failed = tool.evaluate_gate(continuous, static, 8, ledger)
+    assert ok and failed == []
+
+    slow = dict(continuous, tokens_per_s=10.0)
+    ok, failed = tool.evaluate_gate(slow, static, 8, ledger)
+    assert not ok and failed == ["throughput_wins"]
+
+    cold = dict(static, aot_s=2.0)
+    ok, failed = tool.evaluate_gate(continuous, cold, 8, ledger)
+    assert not ok and "warm_start_free" in failed
+
+    short = dict(static, requests=7, tokens=90)
+    ok, failed = tool.evaluate_gate(continuous, short, 8, ledger)
+    assert not ok
+    assert "static_completed" in failed and "token_parity" in failed
+
+
 def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):
     events = {
         "0": [["step", "span", 10.0, 0.2, {"src": "trainer", "step": 1}],

@@ -32,6 +32,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ALL_RULE_IDS = {
     "TRC001", "TRC002", "TRC003", "CMP001", "THR001", "LOG001", "RTY001",
+    "DON001", "DON002", "SHD001", "SHD002", "SEAM001",
 }
 
 
@@ -388,6 +389,317 @@ def test_rty001_swallow_fires_only_in_failure_tiers(tmp_path):
     assert rule_ids(report) == ["RTY001"]
     # The same code outside agent/master/checkpoint is tolerated.
     report = lint(tmp_path, "util.py", RTY001_SWALLOW, select=["RTY001"])
+    assert report.findings == []
+
+
+# -- DON001: use-after-donate ----------------------------------------------
+
+DON001_BAD = """\
+import jax
+
+step = jax.jit(train_step, donate_argnums=(0,))
+
+def fit(state, batches):
+    for batch in batches:
+        out = step(state, batch)
+    return state.params
+"""
+
+# The serving donated-pool idiom: the KV pool is donated to insert and
+# the result is rebound over the operand in the same statement — the
+# stale binding dies with the statement, so the pattern is clean.
+DON001_OK_POOL = """\
+import jax
+
+class Engine:
+    def __init__(self, fn):
+        self._insert = jax.jit(fn, donate_argnums=(0,))
+
+    def admit(self, pool, rows):
+        for row, slot in rows:
+            pool = self._insert(pool, row, slot)
+        return pool
+
+    def admit_cached(self, row, slot):
+        self.cache = self._insert(self.cache, row, slot)
+        return self.cache
+"""
+
+# AOT lowering reads shapes only; .lower on the jitted callable does not
+# consume the buffer.
+DON001_OK_AOT = """\
+import jax
+
+class Engine:
+    def __init__(self, fn):
+        self._insert = jax.jit(fn, donate_argnums=(0,))
+
+    def warm(self, pool, row, slot):
+        lowered = self._insert.lower(pool, row, slot)
+        return lowered.compile(), pool
+"""
+
+
+def test_don001_fires_on_read_after_donate(tmp_path):
+    report = lint(tmp_path, "m.py", DON001_BAD, select=["DON001"])
+    assert rule_ids(report) == ["DON001"]
+    finding = report.findings[0]
+    assert "'state'" in finding.message
+    assert finding.symbol == "fit:state"
+
+
+def test_don001_branch_read_fires(tmp_path):
+    src = """\
+import jax
+
+step = jax.jit(f, donate_argnums=(0,))
+
+def g(state, flag):
+    out = step(state, 1)
+    if flag:
+        return state
+    return out
+"""
+    report = lint(tmp_path, "m.py", src, select=["DON001"])
+    assert rule_ids(report) == ["DON001"]
+
+
+def test_don001_donate_argnames_fires(tmp_path):
+    src = """\
+import jax
+
+step = jax.jit(f, donate_argnames=("state",))
+
+def g(s):
+    out = step(state=s)
+    return s
+"""
+    report = lint(tmp_path, "m.py", src, select=["DON001"])
+    assert rule_ids(report) == ["DON001"]
+
+
+def test_don001_serving_pool_idiom_is_clean(tmp_path):
+    for src in (DON001_OK_POOL, DON001_OK_AOT):
+        report = lint(tmp_path, "m.py", src, select=["DON001"])
+        assert report.findings == []
+
+
+def test_don001_conditional_donation_fires(tmp_path):
+    # train_lib's "(0,) if donate_state else ()" spelling still donates
+    # on some configuration — lint treats it as donating.
+    src = """\
+import jax
+
+step = jax.jit(f, donate_argnums=(0,) if DONATE else ())
+
+def g(state):
+    out = step(state, 1)
+    return state.params, out
+"""
+    report = lint(tmp_path, "m.py", src, select=["DON001"])
+    assert rule_ids(report) == ["DON001"]
+
+
+# -- DON002: donated binding captured by a closure -------------------------
+
+DON002_BAD = """\
+import jax
+
+step = jax.jit(f, donate_argnums=(0,))
+
+def outer(state):
+    def peek():
+        return state.params
+    out = step(state, 1)
+    return out, peek
+"""
+
+DON002_OK_REBOUND = """\
+import jax
+
+step = jax.jit(f, donate_argnums=(0,))
+
+def outer(state):
+    def peek():
+        return state.params
+    state = step(state, 1)
+    return state, peek
+"""
+
+
+def test_don002_fires_on_closure_capture(tmp_path):
+    report = lint(tmp_path, "m.py", DON002_BAD, select=["DON002"])
+    assert rule_ids(report) == ["DON002"]
+    assert "closure" in report.findings[0].message
+
+
+def test_don002_rebound_operand_is_clean(tmp_path):
+    report = lint(tmp_path, "m.py", DON002_OK_REBOUND, select=["DON002"])
+    assert report.findings == []
+
+
+# -- SHD001: PartitionSpec axis drift --------------------------------------
+
+SHD001_BAD = """\
+from jax.sharding import PartitionSpec as P
+
+SPEC = P("dp", None)
+"""
+
+SHD001_OK_CANONICAL = """\
+from jax.sharding import PartitionSpec as P
+
+SPEC = P(("data", "fsdp"), None)
+"""
+
+SHD001_OK_LOCAL_MESH = """\
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(devices, ("rows", "cols"))
+SPEC = P("rows")
+"""
+
+
+def test_shd001_fires_on_unknown_axis(tmp_path):
+    report = lint(tmp_path, "m.py", SHD001_BAD, select=["SHD001"])
+    assert rule_ids(report) == ["SHD001"]
+    assert report.findings[0].symbol == "axis:dp"
+
+
+def test_shd001_canonical_and_local_mesh_axes_are_clean(tmp_path):
+    for src in (SHD001_OK_CANONICAL, SHD001_OK_LOCAL_MESH):
+        report = lint(tmp_path, "m.py", src, select=["SHD001"])
+        assert report.findings == []
+
+
+def test_shd001_resolves_module_constants(tmp_path):
+    src = """\
+from jax.sharding import PartitionSpec as P
+
+ROW_AXIS = "tesnor"
+SPEC = P(ROW_AXIS)
+"""
+    report = lint(tmp_path, "m.py", src, select=["SHD001"])
+    assert rule_ids(report) == ["SHD001"]
+    assert report.findings[0].symbol == "axis:tesnor"
+
+
+# -- SHD002: spec rank exceeds the array's known rank ----------------------
+
+SHD002_BAD = """\
+import jax.numpy as jnp
+from jax.lax import with_sharding_constraint
+from jax.sharding import PartitionSpec as P
+
+def f():
+    x = jnp.zeros((4, 8))
+    x = with_sharding_constraint(x, P("data", "fsdp", "tensor"))
+    return x
+"""
+
+SHD002_OK = """\
+import jax.numpy as jnp
+from jax.lax import with_sharding_constraint
+from jax.sharding import PartitionSpec as P
+
+def f():
+    x = jnp.zeros((4, 8))
+    x = with_sharding_constraint(x, P("data", "fsdp"))
+    return x
+"""
+
+
+def test_shd002_fires_on_rank_overflow(tmp_path):
+    report = lint(tmp_path, "m.py", SHD002_BAD, select=["SHD002"])
+    assert rule_ids(report) == ["SHD002"]
+    assert "rank 2" in report.findings[0].message
+
+
+def test_shd002_matching_rank_and_unknown_rank_are_clean(tmp_path):
+    report = lint(tmp_path, "m.py", SHD002_OK, select=["SHD002"])
+    assert report.findings == []
+    # Rank not statically derivable (function argument): stay silent.
+    unknown = SHD002_OK.replace("x = jnp.zeros((4, 8))", "x = get()")
+    report = lint(tmp_path, "m.py", unknown, select=["SHD002"])
+    assert report.findings == []
+
+
+# -- SEAM001: raw I/O outside Faultline ------------------------------------
+
+SEAM001_BAD = """\
+import os
+
+def persist(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+    os.replace(tmp, path)
+"""
+
+SEAM001_OK = """\
+import os
+from dlrover_tpu.common import faults
+
+def persist(path, blob):
+    faults.fire("storage.write", path=path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+    os.replace(tmp, path)
+"""
+
+SEAM001_UNREGISTERED = """\
+import os
+from dlrover_tpu.common import faults
+
+def persist(path, blob):
+    faults.fire("made.up.seam")
+    os.replace(path + ".tmp", path)
+"""
+
+
+def test_seam001_fires_in_fault_tiers(tmp_path):
+    (tmp_path / "agent").mkdir()
+    report = lint(
+        tmp_path, os.path.join("agent", "m.py"),
+        SEAM001_BAD, select=["SEAM001"],
+    )
+    assert rule_ids(report) == ["SEAM001"]
+    kinds = {f.symbol for f in report.findings}
+    assert kinds == {"persist:open-for-write", "persist:os.replace"}
+
+
+def test_seam001_registered_seam_covers_the_function(tmp_path):
+    (tmp_path / "checkpoint").mkdir()
+    report = lint(
+        tmp_path, os.path.join("checkpoint", "m.py"),
+        SEAM001_OK, select=["SEAM001"],
+    )
+    assert report.findings == []
+
+
+def test_seam001_unregistered_seam_does_not_count(tmp_path):
+    (tmp_path / "master").mkdir()
+    report = lint(
+        tmp_path, os.path.join("master", "m.py"),
+        SEAM001_UNREGISTERED, select=["SEAM001"],
+    )
+    assert rule_ids(report) == ["SEAM001"]
+
+
+def test_seam001_ignores_cold_tiers_and_reads(tmp_path):
+    report = lint(tmp_path, "serving.py", SEAM001_BAD, select=["SEAM001"])
+    assert report.findings == []
+    (tmp_path / "data").mkdir()
+    read_only = """\
+def load(path):
+    with open(path) as fh:
+        return fh.read()
+"""
+    report = lint(
+        tmp_path, os.path.join("data", "m.py"),
+        read_only, select=["SEAM001"],
+    )
     assert report.findings == []
 
 

@@ -117,6 +117,26 @@ def run_leg(config, params, trace, args, static: bool):
     }
 
 
+def evaluate_gate(continuous, static, n_requests, ledger):
+    """The ok gate as a pure predicate: (ok, failed-check names).
+
+    Kept out of ``main`` so the rc contract — exit 0 iff every check
+    holds — is testable without running the bench (``test_tools_cli``).
+    """
+    checks = {
+        "continuous_completed": continuous["requests"] == n_requests,
+        "static_completed": static["requests"] == n_requests,
+        "token_parity": continuous["tokens"] == static["tokens"],
+        "throughput_wins":
+            continuous["tokens_per_s"] > static["tokens_per_s"],
+        "p95_wins": continuous["p95_s"] < static["p95_s"],
+        "warm_start_free": static["aot_s"] == 0.0,
+        "compile_memo_hit": ledger["cached_compiles"] >= 1,
+    }
+    failed = sorted(name for name, held in checks.items() if not held)
+    return not failed, failed
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="continuous- vs static-batching serving bench "
@@ -166,14 +186,8 @@ def main() -> int:
         continuous["tokens_per_s"] / static["tokens_per_s"]
         if static["tokens_per_s"] > 0 else 0.0
     )
-    ok = (
-        continuous["requests"] == len(trace)
-        and static["requests"] == len(trace)
-        and continuous["tokens"] == static["tokens"]
-        and continuous["tokens_per_s"] > static["tokens_per_s"]
-        and continuous["p95_s"] < static["p95_s"]
-        and static["aot_s"] == 0.0
-        and ledger["cached_compiles"] >= 1
+    ok, failed_checks = evaluate_gate(
+        continuous, static, len(trace), ledger
     )
     result = {
         "metric": "continuous-batching speedup over static batching",
@@ -181,6 +195,7 @@ def main() -> int:
         "unit": "x tokens/s",
         "detail": {
             "ok": ok,
+            "failed_checks": failed_checks,
             "continuous": continuous,
             "static": static,
             "speedup_tokens_per_s": round(speedup, 3),

@@ -5,6 +5,7 @@ Usage::
 
     python tools/tracelint.py dlrover_tpu            # text report
     python tools/tracelint.py dlrover_tpu --json     # machine-readable
+    python tools/tracelint.py dlrover_tpu --format sarif  # CI annotation
     python tools/tracelint.py --list-rules
     python tools/tracelint.py pkg --select TRC002,THR001
     python tools/tracelint.py pkg --write-baseline   # grandfather findings
@@ -37,7 +38,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="files or directories to analyze (default: dlrover_tpu)",
     )
     parser.add_argument(
-        "--json", action="store_true", help="emit a JSON report"
+        "--json", action="store_true",
+        help="emit a JSON report (same as --format json)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default=None,
+        help="report format: text (default), json, or sarif "
+        "(SARIF 2.1.0 for CI annotation)",
     )
     parser.add_argument(
         "--select", default="",
@@ -113,7 +120,13 @@ def main(argv=None) -> int:
         )
         return 0
 
-    print(report.render_json() if args.json else report.render_text())
+    fmt = args.format or ("json" if args.json else "text")
+    renderers = {
+        "text": report.render_text,
+        "json": report.render_json,
+        "sarif": report.render_sarif,
+    }
+    print(renderers[fmt]())
     return report.exit_code
 
 

@@ -87,6 +87,12 @@ class ElasticLaunchConfig:
     # the drain (shm flush -> master notice -> trainer stop) must fit
     # inside it.  Cloud TPU maintenance events give 30-60s.
     preempt_grace_s: float = 30.0
+    # Virtual-mesh mode: on membership change, re-join the rendezvous to
+    # adopt the new round but KEEP the trainer process — the trainer
+    # itself folds/fans its logical mesh onto the surviving members
+    # (ElasticTrainer.apply_world_change), so a resize costs a re-layout
+    # in memory instead of a restart + checkpoint restore.
+    live_relayout: bool = False
     # Device-init watchdog (VERDICT r4 #2b): a freshly started trainer
     # that produces no first step report within this bound is stuck below
     # Python (wedged device relay, hung PJRT init) — a failure mode the
@@ -651,6 +657,21 @@ class ElasticAgent:
             code = self._proc.poll()
             if code is None:
                 if self._membership_changed():
+                    if self.config.live_relayout:
+                        # Virtual-mesh path: adopt the new round but keep
+                        # the trainer — it folds its logical mesh onto the
+                        # new member set in place (no restart, no restore).
+                        logger.info(
+                            "membership changed: live relayout (trainer kept)"
+                        )
+                        with self.telemetry.span("rendezvous") as sp:
+                            rdzv = self._rdzv.next_rendezvous()
+                            if sp is not None:
+                                sp.attrs["round"] = rdzv["round"]
+                                sp.attrs["world"] = len(rdzv["world"])
+                                sp.attrs["live_relayout"] = True
+                        self._current_round = rdzv["round"]
+                        continue
                     logger.info("membership changed: restarting with new world")
                     self.client.report_event("restarting", "membership change")
                     # Persist the trainer's latest shm checkpoint first: the

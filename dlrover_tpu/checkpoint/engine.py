@@ -56,6 +56,40 @@ class CheckpointEvent:
     step: int = 0
 
 
+def materialize_records(arrays, meta: CheckpointMeta, shardings, treedef):
+    """Land reassembled tensors as a sharded pytree: ordered leaves →
+    tree_unflatten → ``device_put`` under the target shardings.
+
+    The final step of the any-n→m reshard mapping, shared verbatim by the
+    storage restore path (``CheckpointEngine._materialize``) and the live
+    resize re-layout (``runtime/virtual_mesh.relayout_state``) — one
+    landing function is what makes "live relayout ≡ save + cross-world
+    restore" a bitwise statement rather than an aspiration.
+    """
+    if treedef is None:
+        return arrays
+    ordered = [arrays[t.path] for t in meta.tensors]
+    if shardings is not None:
+        # Zip by LEAVES, not tree_map: the shardings tree may come from a
+        # compile-cache-shared program whose static aux data (apply_fn,
+        # tx identities) differs from this state's treedef, and a
+        # structural map would reject that as a mismatch.
+        sharding_leaves = jax.tree_util.tree_leaves(
+            shardings,
+            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding),
+        )
+        if len(sharding_leaves) != len(ordered):
+            raise ValueError(
+                f"shardings have {len(sharding_leaves)} leaves for "
+                f"{len(ordered)} restored tensors"
+            )
+        ordered = [
+            jax.device_put(jax.numpy.asarray(x), s)
+            for x, s in zip(ordered, sharding_leaves)
+        ]
+    return jax.tree_util.tree_unflatten(treedef, ordered)
+
+
 def default_host_index() -> int:
     """Canonical host identity shared by agent, saver and trainer engine.
 
@@ -551,17 +585,7 @@ class CheckpointEngine:
         # (trainer knob booking: grad_accum/reference world, rng, config)
         # without widening every load path's (step, state) return.
         self.last_restored_extra = dict(getattr(meta, "extra", None) or {})
-        if treedef is None:
-            return arrays
-        ordered = [arrays[t.path] for t in meta.tensors]
-        state = jax.tree_util.tree_unflatten(treedef, ordered)
-        if shardings is not None:
-            state = jax.tree.map(
-                lambda x, s: jax.device_put(jax.numpy.asarray(x), s),
-                state,
-                shardings,
-            )
-        return state
+        return materialize_records(arrays, meta, shardings, treedef)
 
     def wait_saver(self, timeout: float = 600.0):
         """Block until every storage save this engine requested is durable.

@@ -33,7 +33,7 @@ Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``rpc.report``, ``rpc.get``, ``storage.write``, ``storage.read``,
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
 ``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``,
-``tpu.api``.
+``tpu.api``, ``relayout.apply``.
 """
 
 from __future__ import annotations
@@ -80,6 +80,12 @@ KNOWN_SEAMS = (
     # error surfaces as the same CloudError/degrade path a flaky API
     # produces, so launcher retry logic is drillable without GCP.
     "tpu.api",
+    # Live-resize seam: fires at the top of every ElasticTrainer
+    # re-layout attempt (apply_world_change), under its RetryPolicy —
+    # error kinds are retried, and on exhaustion the trainer degrades to
+    # checkpoint restore, booked as resizes_by_reason["relayout_failed"].
+    # Delay kinds stretch the relayout window the resize ledger measures.
+    "relayout.apply",
 )
 
 

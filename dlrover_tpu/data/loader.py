@@ -24,11 +24,23 @@ from dlrover_tpu.common.log import default_logger as logger
 
 
 class ElasticDistributedSampler:
-    """Deterministic rank-strided sampler with save/restore of position.
+    """Deterministic logically-keyed sampler with save/restore of position.
 
     ``state_dict()`` records epoch + completed samples; after an elastic
     resize, ``load_state_dict`` on the new world skips what was consumed —
     semantics match ref ``ElasticDistributedSampler``.
+
+    Virtual-mesh keying: positions past the ``completed`` watermark are
+    assigned to LOGICAL shards round-robin over ``logical_world`` (the
+    job's fixed reference world), and a physical member owns the logical
+    shards ``s % num_replicas == rank`` — the same fold rule as
+    ``runtime/virtual_mesh.VirtualMesh.owner`` (kept inline here so the
+    data tier stays jax-free; the two must not diverge).  Which member
+    *fetches* a sample therefore changes across resizes, but which
+    logical shard it belongs to never does, so a ``rebind_world`` mid-run
+    (live re-layout) leaves the global batch order invariant.  Default
+    ``logical_world=0`` means "= num_replicas": one shard per member —
+    exactly the legacy rank-stride, bit-for-bit.
     """
 
     def __init__(
@@ -38,12 +50,14 @@ class ElasticDistributedSampler:
         rank: int = 0,
         shuffle: bool = True,
         seed: int = 0,
+        logical_world: int = 0,
     ):
         self.dataset_size = dataset_size
         self.num_replicas = num_replicas
         self.rank = rank
         self.shuffle = shuffle
         self.seed = seed
+        self.logical_world = logical_world
         self.epoch = 0
         self.completed = 0  # globally-consumed samples this epoch
 
@@ -51,18 +65,55 @@ class ElasticDistributedSampler:
         self.epoch = epoch
         self.completed = 0
 
+    def owned_logical_shards(self) -> List[int]:
+        """Logical shards folded onto this member at the current binding
+        (empty when the world grew past the logical mesh — member idles)."""
+        world = self.logical_world or self.num_replicas
+        return [
+            s for s in range(world) if s % self.num_replicas == self.rank
+        ]
+
+    def rebind_world(self, rank: int = None, num_replicas: int = None):
+        """Re-bind the physical membership after a live resize.
+
+        Only the fold changes: the logical keying (frozen here on first
+        rebind for legacy samplers constructed without one) is what keeps
+        every global position's shard assignment — and therefore the
+        batch order — invariant across the resize.  ``completed`` and
+        ``epoch`` are deliberately untouched: the watermark is a global
+        property, not a per-member one.
+        """
+        if not self.logical_world:
+            self.logical_world = self.num_replicas
+        if num_replicas is not None:
+            self.num_replicas = max(1, int(num_replicas))
+        if rank is not None:
+            self.rank = int(rank)
+        # A surviving member keeps its identity modulo the new world (the
+        # virtual-mesh fold); without this a shrink would orphan ranks.
+        self.rank %= self.num_replicas
+
     def __iter__(self) -> Iterator[int]:
         order = np.arange(self.dataset_size)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(order)
-        start = self.completed + self.rank
-        for i in range(start, self.dataset_size, self.num_replicas):
-            yield int(order[i])
+        world = self.logical_world or self.num_replicas
+        owned = self.owned_logical_shards()
+        # Shard indexing is RELATIVE to the completed watermark (position
+        # completed+j belongs to logical shard j % world) — the resume
+        # contract the shrink-skew test pins: after a resize at any
+        # watermark, the members' union is exactly the unconsumed suffix.
+        for base in range(self.completed, self.dataset_size, world):
+            for shard in owned:
+                i = base + shard
+                if i < self.dataset_size:
+                    yield int(order[i])
 
     def __len__(self) -> int:
         remaining = self.dataset_size - self.completed
-        return max(0, remaining // self.num_replicas)
+        world = self.logical_world or self.num_replicas
+        return max(0, (remaining * len(self.owned_logical_shards())) // world)
 
     def record_batch(self, global_batch_size: int):
         self.completed += global_batch_size
@@ -270,12 +321,34 @@ class DevicePrefetcher:
     buffered batches unacked for the master to requeue.
 
     Re-iterable when the source is (each ``__iter__`` opens a fresh pass).
+
+    Drain contract (live resize): ``drain()`` bumps a generation token;
+    the active pass notices the stale token before handing out its next
+    batch and re-issues ``place_fn`` for every buffered HOST batch.  The
+    device-resident placements of the old generation are dropped (their
+    layout belonged to the pre-resize program), but no *data* is lost —
+    the host copies are retained, so a lockstep-data run crosses a resize
+    without skipping a single sample.  Same-thread only, like iteration.
     """
 
     def __init__(self, source, place_fn: Callable, depth: int = 2):
         self.source = source
         self.place_fn = place_fn
         self.depth = max(1, depth)
+        # Generation token (the loader's pattern, single-threaded here):
+        # drain() bumps it; the active pass re-places on the mismatch.
+        self._generation = 0
+        self._buf = None  # the active pass's buffer, for drain() to size
+
+    def drain(self) -> int:
+        """Invalidate device-buffered placements (keep their host data).
+
+        Returns how many buffered batches the active pass will re-place.
+        Idempotent and safe with no pass active (a fresh pass always
+        places under the current program).
+        """
+        self._generation += 1
+        return len(self._buf) if self._buf is not None else 0
 
     def _pairs(self) -> Iterator:
         if hasattr(self.source, "batches_with_acks"):
@@ -286,7 +359,11 @@ class DevicePrefetcher:
 
     def __iter__(self) -> Iterator:
         it = self._pairs()
+        gen = self._generation
+        # Entries are (host_batch, placed, ack): the host copy is the
+        # drain path's re-place source.
         buf: collections.deque = collections.deque()
+        self._buf = buf
 
         def top_up():
             while len(buf) < self.depth:
@@ -294,12 +371,20 @@ class DevicePrefetcher:
                     batch, ack = next(it)
                 except StopIteration:
                     return
-                buf.append((self.place_fn(batch), ack))
+                buf.append((batch, self.place_fn(batch), ack))
 
         try:
             top_up()
             while buf:
-                placed, ack = buf.popleft()
+                if gen != self._generation:
+                    # Drained: the buffered placements were issued for the
+                    # pre-resize program — re-place from the retained host
+                    # batches under the current one.
+                    gen = self._generation
+                    for i in range(len(buf)):
+                        batch, _, ack = buf[i]
+                        buf[i] = (batch, self.place_fn(batch), ack)
+                _, placed, ack = buf.popleft()
                 # Place N+1..N+depth BEFORE handing out N: the overlap
                 # contract the pipeline tests assert.
                 top_up()
@@ -309,6 +394,7 @@ class DevicePrefetcher:
                 if ack is not None:
                     ack()
         finally:
+            self._buf = None
             if hasattr(it, "close"):
                 it.close()
 

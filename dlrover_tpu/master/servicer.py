@@ -289,6 +289,22 @@ class MasterServicer:
                     "unparseable compile event from %s: %r",
                     p.node_id, p.detail,
                 )
+        elif p.event == "relayout" and self.speed_monitor is not None:
+            # Virtual-mesh live re-layout: the trainer measured the whole
+            # resize itself (no open window to close), so its seconds land
+            # straight in the resize ledger under kind "relayout" — or as
+            # a "relayout_failed" restore when retries were exhausted.
+            try:
+                detail = json.loads(p.detail or "{}")
+                self.speed_monitor.record_relayout(
+                    float(detail.get("relayout_s", 0.0)),
+                    ok=not bool(detail.get("fallback", False)),
+                )
+            except (ValueError, TypeError):
+                logger.warning(
+                    "unparseable relayout event from %s: %r",
+                    p.node_id, p.detail,
+                )
         if self.node_manager:
             self.node_manager.report_event(p.node_id, p.event, p.detail)
 

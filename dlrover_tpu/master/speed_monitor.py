@@ -49,10 +49,16 @@ class SpeedMonitor:
         # drain, scale plan) and the re-formed world's first step advance.
         # The paper's promise is that this stays seconds — the
         # ``dlrover_resize_seconds_total`` gauge makes it measurable.
+        # Seconds split by KIND: "restore" (rebuild-recompile-restore
+        # cycle, seconds-scale) vs "relayout" (virtual-mesh live
+        # re-layout, milliseconds-scale) — the 10×+ gap between the two
+        # is the headline the live-relayout drill certifies.
         self._resizes = 0
         self._resize_s_total = 0.0
         self._resize_started: Optional[float] = None
+        self._resize_kind = "restore"  # kind of the open window, if any
         self._resizes_by_reason: Dict[str, int] = {}
+        self._resize_s_by_kind: Dict[str, float] = {}
         # SDC digest ledger (trainer/state_digest.py DigestReports): votes
         # are per-step {node: digest} maps; a step is voted once, when a
         # NEWER step's report proves every replica that will ever report it
@@ -87,7 +93,12 @@ class SpeedMonitor:
             if self._resize_started is not None:
                 # First step advance after a resize notice closes the
                 # window: everything in between was resize downtime.
-                self._resize_s_total += max(0.0, ts - self._resize_started)
+                elapsed = max(0.0, ts - self._resize_started)
+                self._resize_s_total += elapsed
+                self._resize_s_by_kind[self._resize_kind] = (
+                    self._resize_s_by_kind.get(self._resize_kind, 0.0)
+                    + elapsed
+                )
                 self._resize_started = None
             if self._last_step_time is not None:
                 # Time between consecutive step reports counts as productive
@@ -285,18 +296,47 @@ class SpeedMonitor:
                 "check_every": self._sdc_check_every,
             }
 
-    def begin_resize(self, reason: str = ""):
+    def begin_resize(self, reason: str = "", kind: str = "restore"):
         """A resize (preemption drain / scale event) started.  The window
         stays open until the next step advance; overlapping notices (every
-        preempted host reports) fold into one window."""
+        preempted host reports) fold into one window.  ``kind`` tags the
+        window's seconds in the per-kind split ("restore" for the classic
+        rebuild cycle; a live re-layout instead books itself in one shot
+        via :meth:`record_relayout`, since the trainer already measured
+        its own milliseconds)."""
         with self._lock:
             if self._resize_started is None:
                 self._resize_started = time.time()
+                self._resize_kind = kind or "restore"
             self._resizes += 1
             if reason:
                 self._resizes_by_reason[reason] = (
                     self._resizes_by_reason.get(reason, 0) + 1
                 )
+
+    def record_relayout(
+        self, seconds: float, ok: bool = True, reason: str = ""
+    ):
+        """One virtual-mesh live re-layout, trainer-measured.
+
+        Unlike :meth:`begin_resize` there is no open window: the trainer
+        performed (and timed) the whole resize itself, so the seconds land
+        directly.  ``ok=False`` is the retry-exhausted degrade — the
+        trainer fell back to checkpoint restore, so the event books under
+        reason ``relayout_failed`` and its seconds under kind "restore"
+        (that is the cycle actually paid)."""
+        kind = "relayout" if ok else "restore"
+        reason = reason or ("relayout" if ok else "relayout_failed")
+        with self._lock:
+            self._resizes += 1
+            self._resizes_by_reason[reason] = (
+                self._resizes_by_reason.get(reason, 0) + 1
+            )
+            seconds = max(0.0, float(seconds))
+            self._resize_s_total += seconds
+            self._resize_s_by_kind[kind] = (
+                self._resize_s_by_kind.get(kind, 0.0) + seconds
+            )
 
     def resize_ledger(self) -> Dict[str, object]:
         with self._lock:
@@ -308,7 +348,12 @@ class SpeedMonitor:
                 "resizes": self._resizes,
                 "resize_s_total": self._resize_s_total,
                 "resize_open_s": open_s,
+                "open_kind": (
+                    self._resize_kind
+                    if self._resize_started is not None else ""
+                ),
                 "by_reason": dict(self._resizes_by_reason),
+                "by_kind": dict(self._resize_s_by_kind),
             }
 
     def compile_ledger(self) -> Dict[str, float]:

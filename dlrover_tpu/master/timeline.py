@@ -247,6 +247,21 @@ class JobTimeline:
                   resize["resize_s_total"] + resize["resize_open_s"],
                   "wall seconds between a resize notice and the next "
                   "step advance (open window included)")
+            # Per-kind split: "restore" = the classic rebuild-recompile-
+            # restore cycle (seconds), "relayout" = virtual-mesh live
+            # re-layout (milliseconds).  The open window's seconds count
+            # under its own kind so the labeled series always sum to the
+            # unlabeled total above (the parity the telemetry test pins).
+            by_kind = dict(resize.get("by_kind", {}))
+            if resize["resize_open_s"]:
+                open_kind = resize.get("open_kind") or "restore"
+                by_kind[open_kind] = (
+                    by_kind.get(open_kind, 0.0) + resize["resize_open_s"]
+                )
+            for kind in ("restore", "relayout"):
+                gauge("dlrover_resize_seconds_total",
+                      by_kind.get(kind, 0.0),
+                      labels=f'{{kind="{kind}"}}')
             serve = speed_monitor.serve_ledger()
             gauge("dlrover_serve_qps", serve["qps"],
                   "completed serving requests/s, summed over replicas")

@@ -62,6 +62,12 @@ def _parse_args(argv: Optional[List[str]] = None):
     parser.add_argument("--heartbeat-interval", type=float, default=15.0)
     parser.add_argument("--network-check", action="store_true")
     parser.add_argument("--save-at-breakpoint", action="store_true")
+    parser.add_argument(
+        "--live-relayout", action="store_true",
+        help="on membership change, re-rendezvous but keep the trainer "
+             "running — it re-lays-out its virtual mesh in place "
+             "(pair with the trainer's --live-relayout flag)",
+    )
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument(
         "--device-init-timeout", type=float, default=900.0,
@@ -283,6 +289,7 @@ def run(argv: Optional[List[str]] = None) -> int:
         save_at_breakpoint=args.save_at_breakpoint,
         checkpoint_dir=args.checkpoint_dir,
         device_init_timeout=args.device_init_timeout,
+        live_relayout=args.live_relayout,
     )
     agent = ElasticAgent(
         config, args.command, master_addr, node_id=args.node_id

@@ -124,6 +124,7 @@ def train_cache_key(
     accum_dtype: str = "float32",
     reduce_quant: str = "none",
     zero1: bool = False,
+    logical_shape=(),
 ) -> str:
     """Name the compiled train program by everything that shapes it.
 
@@ -135,6 +136,13 @@ def train_cache_key(
     change the accumulator and reduce lowering; zero1 reshards the whole
     optimizer update — aliasing any of them would hand a resized world
     the wrong executable).
+
+    ``logical_shape`` is the virtual mesh's resize-INVARIANT bit
+    (``VirtualMesh.logical_shape``: the per-process mesh scaled by the
+    fixed logical world).  It does not vary across resizes — that is the
+    point: the program family a job compiles is named by its logical
+    geometry, and a live resize only moves between grad_accum folds of
+    the same family, every one of which can be prewarmed and hit.
     """
     fields = tuple(sorted(
         (k, repr(v)) for k, v in vars(model_config).items()
@@ -143,6 +151,7 @@ def train_cache_key(
         type(model_config).__name__, fields, tuple(mesh_shape),
         global_batch_size, seq_len, ce_chunks, optimizer,
         grad_accum, accum_dtype, reduce_quant, zero1,
+        tuple(logical_shape),
     ))
 
 

@@ -1,0 +1,169 @@
+"""VirtualMesh: a fixed logical mesh folded onto varying physical members.
+
+VirtualFlow's (PAPERS.md) decoupling applied to the elastic runtime: the
+*logical* mesh is sized once from the job's reference world (``ref_world``
+from the elastic grad-accum booking) and never changes afterwards.  What
+changes on a resize is only the fold — how many logical submeshes each
+surviving member hosts:
+
+- shrink: survivors each pick up extra logical shards (deeper fold, more
+  microbatches per step via ``elastic_grad_accum`` — the narrow special
+  case this class generalizes);
+- grow: the shards fan back out (shallower fold, fewer microbatches).
+
+Because the compiled program is keyed by the *logical* shape (see
+``compile_cache.train_cache_key(logical_shape=...)``) and the per-process
+device mesh is constant, program shapes and GSPMD specs never change
+across resizes: a resize is a re-layout of live state plus a cache hit on
+an already-built program — no recompile, no checkpoint restore.
+
+Ownership rule: logical shard ``s`` lives on physical member ``s % P``.
+At ``P == L`` this degenerates to the identity (one shard per member),
+which is exactly the legacy rank-stride the sampler and grad-accum paths
+always had — the virtual mesh is a strict generalization, not a fork.
+``data.loader.ElasticDistributedSampler`` implements the same rule inline
+(it must stay jax-free); the two must not diverge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclasses.dataclass(frozen=True)
+class VirtualMesh:
+    """Fixed logical mesh of ``logical_world`` host-granular submeshes,
+    currently folded onto ``physical_world`` live members.
+
+    ``mesh`` is the per-process jax Mesh (constant for the process's
+    lifetime — resizes change membership, not local devices), kept here so
+    the logical shape and state re-layout never need to look it up.
+    """
+
+    mesh: Any  # jax.sharding.Mesh
+    logical_world: int
+    physical_world: int
+
+    def __post_init__(self):
+        if self.logical_world < 1 or self.physical_world < 1:
+            raise ValueError(
+                f"worlds must be >= 1, got logical={self.logical_world} "
+                f"physical={self.physical_world}"
+            )
+
+    # -- geometry --------------------------------------------------------------
+
+    @property
+    def fold(self) -> int:
+        """Max logical submeshes any surviving member hosts (ceil(L/P))."""
+        return -(-self.logical_world // self.physical_world)
+
+    @property
+    def logical_shape(self) -> Tuple[int, ...]:
+        """The resize-invariant program shape: the per-process mesh with
+        its outermost (data) axis scaled by the logical world.  Constant
+        across every resize — the bit ``train_cache_key`` carries so one
+        program family serves all folds."""
+        shape = tuple(self.mesh.devices.shape)
+        return (shape[0] * self.logical_world,) + shape[1:]
+
+    def owner(self, shard: int) -> int:
+        """Physical member hosting logical shard ``shard``."""
+        return shard % self.physical_world
+
+    def owned_shards(self, rank: int) -> Tuple[int, ...]:
+        """Logical shards folded onto physical member ``rank`` (empty when
+        the world grew past the logical mesh — the member idles)."""
+        return tuple(
+            range(rank, self.logical_world, self.physical_world)
+        ) if rank < self.physical_world else ()
+
+    def with_world(self, new_world: int) -> "VirtualMesh":
+        """The same logical mesh folded onto ``new_world`` members."""
+        return dataclasses.replace(
+            self, physical_world=max(1, int(new_world))
+        )
+
+    def relayout_plan(self, new_world: int) -> List[Dict[str, int]]:
+        """Shard moves a resize implies: [{shard, src, dst}] for every
+        logical shard whose owner changes (diagnostics / drill booking)."""
+        target = self.with_world(new_world)
+        return [
+            {"shard": s, "src": self.owner(s), "dst": target.owner(s)}
+            for s in range(self.logical_world)
+            if self.owner(s) != target.owner(s)
+        ]
+
+    # -- invariance keys -------------------------------------------------------
+
+    def shard_rng(self, base_key, shard: int):
+        """Per-shard RNG stream keyed by LOGICAL shard index: fold_in of
+        the logical id, never the physical rank, so the stream a submesh
+        draws is identical no matter which member hosts it."""
+        return jax.random.fold_in(base_key, shard % self.logical_world)
+
+    def grad_accum_for(
+        self, ref_accum: int, global_batch_size: int, dp_shards: int
+    ) -> int:
+        """Microbatches per step at the current fold: tokens/step stays
+        pinned to the logical world's budget.  ``elastic_grad_accum`` is
+        the fold realized in time — each extra logical submesh a member
+        hosts becomes one more microbatch through the same program."""
+        # Deferred: trainer-layer import; runtime must not import trainer
+        # at module scope (layering — train_lib itself builds on runtime).
+        from dlrover_tpu.trainer import train_lib
+
+        return train_lib.elastic_grad_accum(
+            ref_accum, self.logical_world, self.physical_world,
+            global_batch_size, dp_shards,
+        )
+
+
+def relayout_state(state, shardings):
+    """Re-lay-out a live pytree under ``shardings`` entirely in memory.
+
+    This is PR 7's any-n→m reshard record mapping with the storage
+    round-trip deleted: flatten the live state into shard records
+    (``shm_handler.pack_pytree``), reassemble each tensor from its records
+    (``assemble_tensor``), and land it exactly the way a restore would
+    (``engine.materialize_records``: tree_unflatten + device_put under the
+    target shardings).  Sharing the pack/assemble/materialize path with
+    the checkpoint engine is what makes the live result bitwise-identical
+    to a save→cross-world-restore cycle — the equivalence the resize
+    matrix test pins.
+
+    Cost model: one host round-trip of the state (D2H gather + H2D place),
+    milliseconds at test scale and HBM-bandwidth-bound on real chips —
+    against the *seconds* a storage restore pays before it even reaches
+    the same materialize step.
+    """
+    from dlrover_tpu.checkpoint import engine as ckpt_engine
+    from dlrover_tpu.checkpoint import shm_handler
+
+    treedef = jax.tree_util.tree_structure(state)
+    meta, blocks = shm_handler.pack_pytree(state, step=0)
+    blocks_by_record: Dict[int, np.ndarray] = {}
+    block_iter = iter(blocks)
+    for tensor in meta.tensors:
+        for record in tensor.shards:
+            blocks_by_record[id(record)] = next(block_iter)
+    arrays = {
+        tensor.path: shm_handler.assemble_tensor(
+            tensor,
+            lambda rec: np.ascontiguousarray(
+                blocks_by_record[id(rec)]
+            ).view(np.uint8).ravel(),
+        )
+        for tensor in meta.tensors
+    }
+    logger.debug(
+        "relayout_state: %d tensors reassembled from %d records in memory",
+        len(meta.tensors), len(blocks_by_record),
+    )
+    return ckpt_engine.materialize_records(arrays, meta, shardings, treedef)

@@ -29,9 +29,11 @@ import optax
 
 from dlrover_tpu.common import faults, telemetry
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import RetryError, RetryPolicy
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 from dlrover_tpu.parallel import rules as lr
 from dlrover_tpu.runtime import compile_cache, env as renv
+from dlrover_tpu.runtime import virtual_mesh
 from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
 from dlrover_tpu.trainer import state_digest, train_lib
 from dlrover_tpu.utils.profiler import pipeline_counters
@@ -108,6 +110,12 @@ class TrainerConfig:
     # construction.  Booked in checkpoint `extra` so a restore into a
     # different world recomputes N from the ORIGINAL reference pairing.
     grad_accum_ref_world: int = 0
+    # -- virtual mesh ---------------------------------------------------------
+    # Logical member count for elastic accounting (0 = jax.device_count()
+    # at construction).  The VirtualMesh folds grad_accum_ref_world
+    # logical submeshes onto this many members; ``apply_world_change``
+    # moves it live without recompiling or restoring from storage.
+    world: int = 0
 
 
 class TrainerCallback:
@@ -219,9 +227,21 @@ class ElasticTrainer:
         # grad_accum_ref_world, default: the current world), snapped to a
         # feasible divisor of the batch sharding.
         self._rules = rules if rules is not None else lr.DEFAULT_RULES
-        self._world = max(1, jax.device_count())
+        self._world = max(1, config.world or jax.device_count())
         self._ref_accum = max(1, config.grad_accum)
         self._ref_world = config.grad_accum_ref_world or self._world
+        # The virtual mesh: logical shape fixed at the reference world for
+        # the life of the job, folded onto however many members are live.
+        # grad_accum is the fold realized in time; the logical shape is
+        # the resize-invariant bit of the compile-cache key.
+        self.vmesh = virtual_mesh.VirtualMesh(
+            self.mesh, logical_world=self._ref_world,
+            physical_world=self._world,
+        )
+        # Live-resize plumbing: the prefetcher handle (for the drain) and
+        # the fit loop's loader (for the sampler rebind).
+        self._prefetcher = None
+        self._active_loader = None
         self.grad_accum = self._resolve_grad_accum()
         if self.grad_accum != self._ref_accum:
             logger.info(
@@ -267,7 +287,7 @@ class ElasticTrainer:
                     state_template=self.state,
                 )
             if restored is not None:
-                self.state = restored
+                self.state = self.train.adopt(restored)
                 self.step = restored_step
                 # A restored step is NOT a step this world has committed:
                 # shm restores (and another world's uncommitted files) are
@@ -292,13 +312,18 @@ class ElasticTrainer:
         )
 
     def _resolve_grad_accum(self) -> int:
-        return train_lib.elastic_grad_accum(
-            self._ref_accum, self._ref_world, self._world,
-            self.config.global_batch_size, self._dp_shards(),
+        return self.vmesh.grad_accum_for(
+            self._ref_accum, self.config.global_batch_size,
+            self._dp_shards(),
         )
 
-    def _build_train(self) -> train_lib.ShardedTrain:
+    def _build_train(
+        self, grad_accum: Optional[int] = None
+    ) -> train_lib.ShardedTrain:
+        """Build (or cache-hit) the step program for ``grad_accum``
+        microbatches (default: the current fold's)."""
         config = self.config
+        accum = self.grad_accum if grad_accum is None else grad_accum
         cache_key = None
         if self._cacheable:
             cache_key = compile_cache.train_cache_key(
@@ -311,17 +336,18 @@ class ElasticTrainer:
                     f"/warmup={config.warmup_steps}"
                     f"/decay={config.decay_steps}"
                 ),
-                grad_accum=self.grad_accum,
+                grad_accum=accum,
                 accum_dtype=config.accum_dtype,
                 reduce_quant=config.reduce_quant,
                 zero1=config.zero1,
+                logical_shape=self.vmesh.logical_shape,
             )
         return train_lib.build_sharded_train(
             self.model, self.optimizer, self.mesh, self._rules,
             global_batch_size=config.global_batch_size,
             seq_len=config.seq_len,
             ce_chunks=config.ce_chunks,
-            grad_accum=self.grad_accum,
+            grad_accum=accum,
             accum_dtype=config.accum_dtype,
             reduce_quant=config.reduce_quant,
             zero1=config.zero1,
@@ -361,6 +387,13 @@ class ElasticTrainer:
         if booked == (self._ref_accum, self._ref_world):
             return
         self._ref_accum, self._ref_world = booked
+        # The logical mesh is sized by the booked reference world — adopt
+        # it so this process's virtual mesh (and program-family key)
+        # matches every other member of the job.
+        self.vmesh = virtual_mesh.VirtualMesh(
+            self.mesh, logical_world=self._ref_world,
+            physical_world=self._world,
+        )
         resolved = self._resolve_grad_accum()
         if resolved == self.grad_accum:
             return
@@ -371,6 +404,196 @@ class ElasticTrainer:
         )
         self.grad_accum = resolved
         self.train = self._build_train()
+
+    # -- virtual mesh: live resize ---------------------------------------------
+
+    def prewarm_worlds(
+        self, worlds: Iterable[int], aot: bool = False
+    ) -> Dict[int, int]:
+        """Build the program family for every fold ``worlds`` implies, so
+        a later ``apply_world_change`` to any of them is a pure build-
+        cache hit (VirtualFlow's precompile-all-configurations move —
+        cheap because every fold shares the logical shape and differs
+        only in grad_accum).  ``aot=True`` additionally lowers+compiles
+        each step program now; with it a resize to a warmed world
+        performs ZERO traces and ZERO compiles.  Needs the in-process
+        build cache (``reuse_compiled`` with default optimizer/rules) to
+        retain anything.  Returns ``{world: grad_accum}``."""
+        out: Dict[int, int] = {}
+        for world in worlds:
+            vm = self.vmesh.with_world(int(world))
+            accum = vm.grad_accum_for(
+                self._ref_accum, self.config.global_batch_size,
+                self._dp_shards(),
+            )
+            train = self._build_train(grad_accum=accum)
+            if aot:
+                train.aot_compile()
+            out[int(world)] = accum
+        return out
+
+    def apply_world_change(
+        self, new_world: int, reason: str = "scale"
+    ) -> Dict[str, Any]:
+        """Live re-layout to a resized world: no recompile, no restore.
+
+        The graceful-resize path: the job world changed (a drained
+        preemption, a scale plan) but THIS member survived, so its live
+        state is authoritative — re-fold the virtual mesh onto the new
+        member count in memory and keep stepping.  ``self.step`` is never
+        rewound: the graceful path loses zero steps by construction.
+
+        Retries ride the ``relayout.apply`` Faultline seam under a
+        RetryPolicy; on exhaustion (or a member dying WITHOUT grace, when
+        the re-layout source state is gone) the path degrades to the
+        classic checkpoint restore, booked master-side as
+        ``resizes_by_reason["relayout_failed"]``.
+
+        Returns the booking detail (also shipped as a "relayout" node
+        event + telemetry event): ok/fallback flags, worlds, fold,
+        grad_accum, relayout seconds.
+        """
+        new_world = max(1, int(new_world))
+        if new_world == self._world:
+            return {
+                "ok": True, "noop": True, "fallback": False,
+                "old_world": self._world, "new_world": new_world,
+            }
+        # Barrier: the deferred-metrics ring references the pre-resize
+        # program's outputs — flush under their own step attribution.
+        self._flush_metrics()
+        old_world = self._world
+        t0 = time.perf_counter()
+        policy = RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            name="relayout.apply", quiet=True,
+        )
+        try:
+            detail = policy.call(self._relayout, new_world)
+        except RetryError as e:
+            return self._relayout_fallback(new_world, reason, e)
+        relayout_s = time.perf_counter() - t0
+        detail.update(
+            ok=True, fallback=False, old_world=old_world,
+            new_world=new_world, reason=reason,
+            relayout_s=round(relayout_s, 6),
+        )
+        logger.info(
+            "live relayout: world %d -> %d (fold %d, grad_accum %d) in "
+            "%.1f ms", old_world, new_world, detail["fold"],
+            detail["grad_accum"], relayout_s * 1e3,
+        )
+        self._ship_relayout(detail, relayout_s)
+        return detail
+
+    def _relayout(self, new_world: int) -> Dict[str, Any]:
+        """One re-layout attempt; commits only once everything succeeded,
+        so a retried attempt always starts from a consistent trainer."""
+        faults.fire(
+            "relayout.apply", old_world=self._world, new_world=new_world,
+        )
+        vmesh = self.vmesh.with_world(new_world)
+        accum = vmesh.grad_accum_for(
+            self._ref_accum, self.config.global_batch_size,
+            self._dp_shards(),
+        )
+        # Drain the prefetcher (generation token): device placements of
+        # the old fold are dropped, host batches retained and re-placed.
+        drained = (
+            self._prefetcher.drain() if self._prefetcher is not None else 0
+        )
+        rebuilt = accum != self.grad_accum
+        # A pure cache hit after prewarm_worlds — the logical shape in
+        # the key never changed, only the fold's grad_accum did.
+        train = self._build_train(grad_accum=accum) if rebuilt else self.train
+        # In-memory re-layout of params/opt-state/RNG: PR 7's reshard
+        # record mapping without the storage round-trip.  Transient cost:
+        # one host copy of the state.
+        state = train.adopt(
+            virtual_mesh.relayout_state(self.state, train.state_shardings)
+        )
+        moves = len(self.vmesh.relayout_plan(new_world))
+        self.vmesh = vmesh
+        self._world = new_world
+        self.grad_accum = accum
+        self.train = train
+        self.state = state
+        rebound = self._rebind_sampler(new_world)
+        return {
+            "fold": vmesh.fold, "grad_accum": accum,
+            "drained_batches": drained, "rebuilt_program": rebuilt,
+            "shard_moves": moves, "sampler_rebound": rebound,
+        }
+
+    def _relayout_fallback(
+        self, new_world: int, reason: str, err: BaseException
+    ) -> Dict[str, Any]:
+        """Re-layout exhausted its retries: degrade to checkpoint restore
+        (the same cycle an ungraceful member death forces — the live
+        source state is unusable/gone, storage is the only truth)."""
+        logger.error(
+            "live relayout to world %d failed after retries (%s); "
+            "degrading to checkpoint restore", new_world, err,
+        )
+        if self._ckpt is None:
+            raise err
+        old_world = self._world
+        t0 = time.perf_counter()
+        if self._prefetcher is not None:
+            self._prefetcher.drain()
+        self.vmesh = self.vmesh.with_world(new_world)
+        self._world = new_world
+        resolved = self._resolve_grad_accum()
+        if resolved != self.grad_accum:
+            self.grad_accum = resolved
+            self.train = self._build_train()
+        with telemetry.span("restore"):
+            restored_step, restored = self._ckpt.load_checkpoint(
+                shardings=self.train.state_shardings,
+                state_template=self.state,
+            )
+        if restored is None:
+            raise err
+        self.state = self.train.adopt(restored)
+        self.step = restored_step
+        self._last_saved = -1
+        self._adopt_checkpoint_accum(self._ckpt.last_extra)
+        self._rebind_sampler(new_world)
+        restore_s = time.perf_counter() - t0
+        detail = {
+            "ok": True, "fallback": True, "old_world": old_world,
+            "new_world": new_world, "reason": reason,
+            "relayout_s": round(restore_s, 6),
+            "restored_step": restored_step,
+            "grad_accum": self.grad_accum,
+        }
+        logger.warning(
+            "relayout fallback: restored step %d from checkpoint in "
+            "%.2f s", restored_step, restore_s,
+        )
+        self._ship_relayout(detail, restore_s)
+        return detail
+
+    def _rebind_sampler(self, new_world: int) -> bool:
+        """Rebind the active loader's sampler onto the new physical world
+        (its logical keying keeps the batch order invariant).  Lockstep
+        and dynamic-sharding sources carry no rank binding — no-op."""
+        loader = self._active_loader
+        for candidate in (loader, getattr(loader, "source", None)):
+            if candidate is not None and hasattr(candidate, "rebind_world"):
+                candidate.rebind_world(num_replicas=new_world)
+                return True
+        return False
+
+    def _ship_relayout(self, detail: Dict[str, Any], seconds: float):
+        attrs = {k: v for k, v in detail.items() if k != "relayout_s"}
+        telemetry.event("relayout", duration_s=seconds, **attrs)
+        if self.client is not None:
+            try:
+                self.client.report_event("relayout", json.dumps(detail))
+                telemetry.recorder().ship(self.client)
+            except Exception as e:  # noqa: BLE001 — booking is best-effort
+                logger.warning("relayout report failed: %s", e)
 
     # -- loop -----------------------------------------------------------------
 
@@ -445,14 +668,19 @@ class ElasticTrainer:
         N+1's H2D placement is issued before batch N is even handed to
         ``train_step`` (whose ``shard_batch`` then passes it through)."""
         if self.config.prefetch_to_device <= 0:
+            self._prefetcher = None
             return loader
         from dlrover_tpu.data.loader import DevicePrefetcher
 
-        return DevicePrefetcher(
+        # The handle is kept for apply_world_change's drain; place_fn
+        # reads ``self.train`` at call time, so a post-resize re-place
+        # lands under the new fold's program with no rebinding.
+        self._prefetcher = DevicePrefetcher(
             loader,
             lambda batch: train_lib.shard_batch(batch, self.train),
             depth=self.config.prefetch_to_device,
         )
+        return self._prefetcher
 
     # -- deferred metrics ------------------------------------------------------
 
@@ -599,6 +827,7 @@ class ElasticTrainer:
             self.epoch = self.step // steps_per_epoch
         self._on_step = on_step
         self._fit_max_steps = max_steps
+        self._active_loader = loader  # apply_world_change's sampler rebind
         lag = max(0, cfg.metrics_lag)
         self._dispatch("on_train_begin")
         done = False

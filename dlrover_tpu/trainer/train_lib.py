@@ -257,11 +257,25 @@ class ShardedTrain:
     # Leaf counts + per-device bytes from the zero1 spec derivation —
     # what bench/PROFILE report as the replicated-vs-sharded memory model.
     zero1_stats: Optional[Dict[str, Any]] = None
+    # Canonical pytree statics the program was compiled against.  TrainState
+    # metadata carries apply_fn/tx identities, and optax transforms compare
+    # by function identity — so a state built by a DIFFERENT trainer whose
+    # cache key aliased this program would retrace (jit) or be rejected
+    # outright (AOT).  adopt() rebinds a state to these canonical statics.
+    apply_fn: Optional[Callable] = None
+    tx: Optional[optax.GradientTransformation] = None
     _aot_step: Optional[Callable] = None
 
     def init(self, rng: jax.Array) -> TrainState:
         with use_mesh(self.mesh):
             return self.init_fn(rng)
+
+    def adopt(self, state: TrainState) -> TrainState:
+        """Rebind a state's static metadata (apply_fn/tx) to the identities
+        this program was compiled with; array leaves are untouched."""
+        if self.apply_fn is None:
+            return state
+        return state.replace(apply_fn=self.apply_fn, tx=self.tx)
 
     def step(self, state: TrainState, batch: Dict[str, jax.Array]):
         with use_mesh(self.mesh):
@@ -842,6 +856,8 @@ def build_sharded_train(
         reduce_quant=reduce_quant,
         zero1=zero1_active,
         zero1_stats=zero1_stats,
+        apply_fn=model.apply,
+        tx=optimizer,
         batch_avals={
             "inputs": token_aval,
             "targets": token_aval,

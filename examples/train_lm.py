@@ -92,6 +92,16 @@ def parse_args():
                         "for the SDC drill on CPU worlds, where each node "
                         "is its own data replica and digests only agree if "
                         "the replicas train on the same batches")
+    p.add_argument("--ref-world", type=int, default=0,
+                   help="logical member count the job was sized for "
+                        "(virtual-mesh reference world). 0 = infer from "
+                        "jax.device_count(); set explicitly in multi-agent "
+                        "drills where each trainer is a 1-device world")
+    p.add_argument("--live-relayout", action="store_true",
+                   help="poll the master's node ledger and fold/fan the "
+                        "virtual mesh in place when the live member count "
+                        "changes (apply_world_change) instead of waiting "
+                        "for a restart + checkpoint restore")
     p.add_argument("--timeline", default="",
                    help="write this process's telemetry (step/compile/"
                         "checkpoint spans) as a Chrome-trace JSON at exit "
@@ -147,6 +157,8 @@ def main():
             reduce_quant=args.reduce_quant,
             zero1=args.zero1,
             sdc_check_every=args.sdc_check_every,
+            world=args.ref_world,
+            grad_accum_ref_world=args.ref_world,
         ),
         client=client,
     )
@@ -177,11 +189,35 @@ def main():
         source=loader_source,
     )
 
+    # Live-relayout: watch the master's node ledger and fold/fan the
+    # virtual mesh in place when the live member count changes.  Dead or
+    # preempting members drop out of the "running" set; the survivor
+    # re-lays-out state onto itself instead of restarting from storage.
+    live_world = [trainer.vmesh.physical_world]
+
+    def _poll_world(step):
+        try:
+            status = client.get_job_status()
+        except Exception as e:  # noqa: BLE001 - master may be mid-resize
+            logger.warning("live-relayout: job status poll failed: %s", e)
+            return
+        alive = sum(1 for s in status.nodes.values() if s == "running")
+        if alive >= 1 and alive != live_world[0]:
+            logger.info(
+                "live-relayout: world %d -> %d at step %d",
+                live_world[0], alive, step,
+            )
+            detail = trainer.apply_world_change(alive, reason="scale")
+            if detail.get("ok"):
+                live_world[0] = alive
+
     def on_step(step, metrics):
         if args.fail_at_step and step == args.fail_at_step:
             if renv.restart_count() == 0:
                 logger.error("test hook: crashing at step %d", step)
                 os._exit(17)
+        if args.live_relayout and client is not None and step % 2 == 0:
+            _poll_world(step)
         if args.step_sleep:
             time.sleep(args.step_sleep)
 

@@ -60,6 +60,89 @@ def test_sampler_checkpoint_resume():
     assert not (set(resumed) & consumed)
 
 
+def test_sampler_logical_shard_keying_disjoint_cover():
+    """Virtual-mesh keying: positions belong to LOGICAL shards (j % L),
+    members own the shards that fold onto them (s % P == rank).  A
+    2-member world over 4 logical shards covers exactly what the
+    4-member world covers, member r taking the union of logical shards
+    r and r+2 — the same strided fold the trainer's VirtualMesh uses."""
+    n, L, seed = 48, 4, 5
+    folded = [
+        ElasticDistributedSampler(
+            n, num_replicas=2, rank=r, shuffle=True, seed=seed,
+            logical_world=L,
+        )
+        for r in range(2)
+    ]
+    assert folded[0].owned_logical_shards() == [0, 2]
+    assert folded[1].owned_logical_shards() == [1, 3]
+    legacy = [
+        ElasticDistributedSampler(
+            n, num_replicas=4, rank=r, shuffle=True, seed=seed
+        )
+        for r in range(4)
+    ]
+    per_member = [sorted(list(s)) for s in folded]
+    # Disjoint and complete...
+    flat = sum(per_member, [])
+    assert sorted(flat) == sorted(set(flat))
+    assert len(flat) == n
+    # ...and each member consumes EXACTLY its logical shards' samples —
+    # the samples ranks r and r+2 of the 4-world would have consumed.
+    for r in range(2):
+        want = sorted(list(legacy[r]) + list(legacy[r + 2]))
+        assert per_member[r] == want
+
+
+def test_sampler_grow_resume_2_to_4():
+    """Grow-path resume: consume under a folded 2-member world (L=4),
+    rebind the survivors and add two fresh members — the four-way
+    continuation equals the never-resized 4-member run, per rank."""
+    n, L, seed, consumed = 48, 4, 9, 16
+    folded = [
+        ElasticDistributedSampler(
+            n, num_replicas=2, rank=r, shuffle=True, seed=seed,
+            logical_world=L,
+        )
+        for r in range(2)
+    ]
+    for s in folded:
+        s.record_batch(consumed)
+    state = folded[0].state_dict()
+
+    # Members 0/1 rebind in place; members 2/3 are fresh joiners that
+    # load the same shard watermark.
+    grown = []
+    for r in range(4):
+        if r < 2:
+            folded[r].rebind_world(rank=r, num_replicas=4)
+            grown.append(folded[r])
+        else:
+            s = ElasticDistributedSampler(
+                n, num_replicas=4, rank=r, shuffle=True, seed=seed,
+                logical_world=L,
+            )
+            s.load_state_dict(state)
+            grown.append(s)
+
+    reference = [
+        ElasticDistributedSampler(
+            n, num_replicas=4, rank=r, shuffle=True, seed=seed,
+            logical_world=L,
+        )
+        for r in range(4)
+    ]
+    for s in reference:
+        s.load_state_dict({"epoch": 0, "completed": consumed})
+
+    for r in range(4):
+        assert list(grown[r]) == list(reference[r]), f"rank {r} diverged"
+    # And the union is exactly the unconsumed suffix of the epoch order.
+    flat = sum((list(s) for s in reference), [])
+    order = np.random.default_rng(seed).permutation(n)
+    assert sorted(flat) == sorted(int(x) for x in order[consumed:])
+
+
 def test_loader_collate_and_prefetch():
     loader = ElasticDataLoader(
         synthetic_lm_sample_fn(vocab_size=50, seq_len=16),

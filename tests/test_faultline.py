@@ -111,6 +111,42 @@ def test_serve_admit_seam_is_known_and_plans_parse():
     assert ei.value.seam == "serve.admit" and ei.value.hit == 2
 
 
+def test_relayout_apply_seam_is_known_and_plans_parse():
+    """The live-resize seam speaks the standard grammar: one-shot hit,
+    delay cadence, and probabilistic forms all parse, and the seam is
+    registered in KNOWN_SEAMS (typo'd drill plans warn as unknown)."""
+    assert "relayout.apply" in faults.KNOWN_SEAMS
+    rules = faults.parse_plan(
+        "relayout.apply:error@1;relayout.apply:delay=0.01@every:2"
+    )
+    assert rules[0].kind == "error" and rules[0].hits == {1}
+    assert rules[1].kind == "delay" and rules[1].every == 2
+    assert faults.parse_plan("relayout.apply:error@p=0.5")[0].prob == 0.5
+
+
+def test_relayout_apply_retries_then_succeeds():
+    """A transient relayout.apply fault burns retry attempts, not the
+    resize: the trainer's RetryPolicy eats the first scripted error and
+    the second attempt lands (the fallback path stays untouched)."""
+    from dlrover_tpu.common.retry import RetryPolicy
+
+    faults.configure("relayout.apply:error@1", seed=3)
+    attempts = []
+
+    def relayout():
+        attempts.append(1)
+        faults.fire("relayout.apply", old_world=4, new_world=2)
+        return "laid-out"
+
+    policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.001, max_delay_s=0.01,
+        name="relayout.apply", quiet=True,
+    )
+    assert policy.call(relayout) == "laid-out"
+    assert len(attempts) == 2  # one injected failure, then the real pass
+    assert ("relayout.apply", "error", 1) in faults.active().fired
+
+
 @pytest.mark.parametrize("bad", [
     "storage.write",                 # no kind
     "storage.write:explode",         # unknown kind

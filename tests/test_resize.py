@@ -14,7 +14,12 @@ Four tiers, mirroring the PR's layers:
    resize ledger window, and lands on the timeline/metrics surfaces;
 4. the trainer chaos run — a run preempted mid-stream resumes on a "new
    host" (no shm, storage-only restore) from the last persisted checkpoint
-   with a loss trajectory equal to the never-interrupted run (SGD parity).
+   with a loss trajectory equal to the never-interrupted run (SGD parity);
+5. the virtual mesh — a resize is a live re-layout: logical shards fold
+   onto survivors (or fan out to joiners) in memory through the same
+   record mapping the storage restore uses (bitwise-equal state), the
+   program family never retraces across folds, and an ungraceful
+   ``relayout.apply`` failure falls back to the checkpoint-restore path.
 """
 
 import os
@@ -423,3 +428,199 @@ def test_preempt_resume_loss_trajectory_invariance(tmp_path, monkeypatch):
         np.testing.assert_allclose(
             resumed_losses[step], base_losses[step], rtol=1e-5,
         )
+
+
+# -- tier 5: the virtual mesh (live relayout) ----------------------------------
+
+
+def test_virtual_mesh_ownership_and_plan():
+    """Pure shard arithmetic: strided ownership, identity at L == P,
+    fold factor, and the relayout plan listing exactly the moved shards."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    from dlrover_tpu.runtime import virtual_mesh
+    from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+
+    mesh = build_mesh(ParallelConfig())
+    vm = virtual_mesh.VirtualMesh(mesh, logical_world=4, physical_world=4)
+    # Identity at L == P: shard s lives on member s — legacy rank-stride.
+    assert [vm.owner(s) for s in range(4)] == [0, 1, 2, 3]
+    assert vm.fold == 1
+    folded = vm.with_world(2)
+    assert folded.fold == 2
+    assert folded.owned_shards(0) == (0, 2)
+    assert folded.owned_shards(1) == (1, 3)
+    assert folded.owned_shards(2) == ()
+    # Shrink 4 -> 2 moves exactly the shards of the retiring members.
+    plan = vm.relayout_plan(2)
+    assert plan == [
+        {"shard": 2, "src": 2, "dst": 0},
+        {"shard": 3, "src": 3, "dst": 1},
+    ]
+    # Grow 2 -> 4 is the inverse fan-out.
+    assert folded.relayout_plan(4) == [
+        {"shard": 2, "src": 0, "dst": 2},
+        {"shard": 3, "src": 1, "dst": 3},
+    ]
+    # The logical shape is world-invariant — the compile-key bit that
+    # keeps GSPMD specs identical across every fold.
+    assert vm.logical_shape == folded.logical_shape
+    # Shard RNG keys to the LOGICAL index: fold-invariant streams.
+    k_a = vm.shard_rng(jax.random.PRNGKey(0), 3)
+    k_b = folded.shard_rng(jax.random.PRNGKey(0), 3)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(k_a)), np.asarray(jax.device_get(k_b))
+    )
+
+
+def _lm_model():
+    from dlrover_tpu.models.gpt2 import gpt2_config
+
+    return gpt2_config(
+        "124m", num_layers=1, d_model=64, num_heads=2,
+        vocab_size=256, max_seq_len=32,
+    )
+
+
+def _lm_batches(n, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        t = rng.integers(0, 256, size=(batch, 33), dtype=np.int32)
+        out.append({"inputs": t[:, :-1], "targets": t[:, 1:]})
+    return out
+
+
+def _live_trainer(ckpt_dir, world, ckpt_every=2):
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    return ElasticTrainer(
+        _lm_model(),
+        TrainerConfig(
+            global_batch_size=16, seq_len=32, optimizer="sgd",
+            learning_rate=1e-2, ckpt_every=ckpt_every,
+            checkpoint_dir=ckpt_dir, world=world, grad_accum_ref_world=4,
+            report_every=1000, numeric_checks=False,
+        ),
+        client=None,
+    )
+
+
+def test_live_relayout_matches_checkpoint_reshard(tmp_path, monkeypatch):
+    """Shrink/grow chain: the state every live relayout in a 4 -> 2 -> 1
+    -> 4 cycle lays out in memory is BITWISE the state the storage
+    restore path reshards into a fresh world — same record mapping, no
+    storage in between.  The chain covers a fold, a deep fold, and the
+    fan-out back; same-world relayout short-circuits as a noop."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    ckpt = str(tmp_path / "ckpt")
+    job = os.environ["DLROVER_TPU_JOB"]
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"{job}_a")
+    a = _live_trainer(ckpt, world=4)
+    b = None
+    try:
+        a.fit(iter(_lm_batches(4)), max_steps=4)
+        assert a._ckpt.wait(timeout=60)  # step 4 committed to storage
+
+        # Fresh job tag: no shm, the restore is forced through storage —
+        # the PR 7 cross-world reshard path.  One restored reference
+        # witnesses the whole chain (in-process, every world lays the
+        # same global arrays onto the same devices).
+        monkeypatch.setenv("DLROVER_TPU_JOB", f"{job}_b")
+        b = _live_trainer(ckpt, world=2)
+        assert b.step == 4
+        want = [
+            np.asarray(jax.device_get(leaf))
+            for leaf in jax.tree_util.tree_leaves(b.state)
+        ]
+
+        noop = a.apply_world_change(4)
+        assert noop["ok"] and noop.get("noop")
+
+        for m in (2, 1, 4):
+            detail = a.apply_world_change(m)
+            assert detail["ok"] and not detail["fallback"], detail
+            assert detail["new_world"] == m
+            assert a.step == 4  # never rewound: zero steps lost
+            assert a.vmesh.physical_world == m
+            got = jax.tree_util.tree_leaves(a.state)
+            assert len(got) == len(want)
+            for ga, wb in zip(got, want):
+                np.testing.assert_array_equal(
+                    np.asarray(jax.device_get(ga)), wb
+                )
+    finally:
+        a.close()
+        if b is not None:
+            b.close()
+
+
+def test_live_relayout_never_retraces(tmp_path, monkeypatch):
+    """After prewarming the fold family, a 4 -> 2 -> 4 resize cycle plus
+    training steps triggers ZERO fresh traces: programs are compiled
+    against the logical mesh, so folds only swap grad-accum variants that
+    are already cached."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    import trace_asserts
+
+    job = os.environ["DLROVER_TPU_JOB"]
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"{job}_nt")
+    trainer = _live_trainer("", world=4)
+    try:
+        assert trainer.prewarm_worlds([1, 2, 4], aot=True)
+        data = _lm_batches(8)
+        trainer.fit(iter(data[:2]), max_steps=2)  # warm: first trace paid
+        with trace_asserts.assert_no_retrace("train_step", "init"):
+            assert trainer.apply_world_change(2)["ok"]
+            trainer.fit(iter(data[2:4]), max_steps=4)
+            assert trainer.apply_world_change(4)["ok"]
+            trainer.fit(iter(data[4:6]), max_steps=6)
+            assert trainer.apply_world_change(1)["ok"]
+            trainer.fit(iter(data[6:8]), max_steps=8)
+    finally:
+        trainer.close()
+
+
+def test_relayout_failure_falls_back_to_restore(tmp_path, monkeypatch):
+    """A member dying WITHOUT grace mid-relayout: every ``relayout.apply``
+    attempt errors, the retry budget exhausts, and the trainer falls back
+    to the checkpoint-restore path — state rewinds to the freshest
+    restorable step (live shm here, storage on a genuinely new host) and
+    the fallback is booked, not silently swallowed."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    ckpt = str(tmp_path / "ckpt")
+    job = os.environ["DLROVER_TPU_JOB"]
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"{job}_fb")
+    trainer = _live_trainer(ckpt, world=4, ckpt_every=4)
+    try:
+        data = _lm_batches(6)
+        trainer.fit(iter(data[:4]), max_steps=4)
+        assert trainer._ckpt.wait(timeout=60)  # step 4 committed
+        trainer.fit(iter(data[4:]), max_steps=6)  # steps 5-6: uncommitted
+        assert trainer.step == 6
+
+        faults.configure("relayout.apply:error")  # every attempt dies
+        detail = trainer.apply_world_change(2)
+        assert detail["ok"] and detail["fallback"]
+        # The fallback IS a restore: state rewinds to a restorable step
+        # (the in-process shm flash checkpoint holds step 6; a new host
+        # with no shm would land on storage's step 4)...
+        assert detail["restored_step"] in (4, 6)
+        assert trainer.step == detail["restored_step"]
+        # ...and the world change still landed.
+        assert trainer.vmesh.physical_world == 2
+        # The retry policy burned its full budget on the seam first.
+        fired = [f for f in faults.active().fired
+                 if f[0] == "relayout.apply"]
+        assert len(fired) == 3
+    finally:
+        faults.reset()
+        trainer.close()
+
+

@@ -400,6 +400,80 @@ def test_render_metrics_goodput_matches_speed_monitor():
     assert metrics["dlrover_serve_replicas"] == 1
 
 
+def test_resize_seconds_split_by_kind_gauge_parity():
+    """The resize ledger splits seconds by kind (restore vs relayout);
+    the exposition's labeled ``dlrover_resize_seconds_total{kind=...}``
+    lines must sum to the unlabeled total — open windows included, folded
+    into the kind that opened them."""
+    sm = SpeedMonitor()
+    now = time.time()
+    sm.collect_global_step(1, now, tokens=100)
+    # A classic restore-path resize window, opened then closed by the
+    # next step advance.
+    sm.begin_resize(reason="preempt:1")
+    time.sleep(0.01)
+    sm.collect_global_step(2, now + 1.0, tokens=100)
+    # Two live relayouts: one clean (ms-scale), one that fell back.
+    sm.record_relayout(0.004)
+    sm.record_relayout(1.5, ok=False)
+
+    ledger = sm.resize_ledger()
+    assert ledger["resizes"] == 3
+    assert ledger["by_reason"]["preempt:1"] == 1
+    assert ledger["by_reason"]["relayout"] == 1
+    assert ledger["by_reason"]["relayout_failed"] == 1
+    assert ledger["by_kind"]["relayout"] == pytest.approx(0.004)
+    assert ledger["by_kind"]["restore"] >= 1.5
+    assert ledger["open_kind"] == ""
+
+    timeline = JobTimeline()
+    text = timeline.render_metrics(speed_monitor=sm)
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            metrics[key] = float(value)
+    labeled = (
+        metrics['dlrover_resize_seconds_total{kind="restore"}']
+        + metrics['dlrover_resize_seconds_total{kind="relayout"}']
+    )
+    assert labeled == pytest.approx(metrics["dlrover_resize_seconds_total"])
+    assert metrics['dlrover_resize_seconds_total{kind="relayout"}'] == (
+        pytest.approx(0.004)
+    )
+    assert metrics["dlrover_resizes_total"] == 3
+
+
+def test_resize_open_window_folds_into_open_kind():
+    """While a resize window is still open, its elapsed seconds appear in
+    BOTH the unlabeled total and the opening kind's label — the parity
+    invariant holds mid-resize, not just after the window closes."""
+    sm = SpeedMonitor()
+    now = time.time()
+    sm.collect_global_step(1, now, tokens=100)
+    sm.begin_resize(reason="scale", kind="restore")
+    time.sleep(0.02)
+    ledger = sm.resize_ledger()
+    assert ledger["open_kind"] == "restore"
+    assert ledger["resize_open_s"] > 0.0
+    timeline = JobTimeline()
+    text = timeline.render_metrics(speed_monitor=sm)
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            metrics[key] = float(value)
+    labeled = (
+        metrics['dlrover_resize_seconds_total{kind="restore"}']
+        + metrics['dlrover_resize_seconds_total{kind="relayout"}']
+    )
+    # Both totals race the open window's clock; allow scheduler slop.
+    assert labeled == pytest.approx(
+        metrics["dlrover_resize_seconds_total"], abs=0.05
+    )
+    assert metrics['dlrover_resize_seconds_total{kind="restore"}'] > 0.0
+
+
 def test_render_metrics_includes_node_manager_relaunches():
     timeline = JobTimeline()
     nm = NodeManager(num_nodes=2)

@@ -54,6 +54,9 @@ def test_goodput_bench_help(cpu_child_env):
     assert out.returncode == 0, out.stderr
     assert "--fault-plan" in out.stdout and "--fault-seed" in out.stdout
     assert "--resize-drill" in out.stdout
+    assert "--live-relayout" in out.stdout
+    # The parity child is an internal spawn target, not operator surface.
+    assert "--live-parity-child" not in out.stdout
     assert "--drill-preempt-hit" in out.stdout
     assert "--sdc-drill" in out.stdout
     assert "--sdc-check-every" in out.stdout

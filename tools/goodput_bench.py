@@ -9,6 +9,8 @@ on a schedule, and report the master SpeedMonitor's goodput ledger.
 
     python tools/goodput_bench.py --steps 400 --kill-every 60 --out GOODPUT.json
     python tools/goodput_bench.py --resize-drill --steps 120 --out DRILL.json
+    python tools/goodput_bench.py --resize-drill --live-relayout --steps 80 \\
+        --step-sleep 0.3 --drill-preempt-hit 10 --out RESIZE_LIVE.json
     python tools/goodput_bench.py --sdc-drill --steps 60 --step-sleep 0.2 \\
         --sdc-check-every 8 --out SDC.json
 
@@ -208,6 +210,286 @@ def run_resize_drill(args) -> int:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     return 0 if result["detail"]["completed"] else 1
+
+
+def run_live_relayout_drill(args) -> int:
+    """Live virtual-mesh resize drill: relayout vs rebuild-restore.
+
+    Three phases, one artifact (RESIZE_LIVE.json):
+
+    A. **Live 2 -> 1**: both agents run ``--live-relayout``; node 1's
+       scripted ``preempt.notice`` drains it, node 0's agent re-joins the
+       rendezvous but KEEPS its trainer, which folds the virtual mesh onto
+       itself in place (``apply_world_change``) — the master books the
+       relayout (ms) in the resize ledger's ``by_kind``.  ``steps_lost``
+       is 0 by construction when the survivor finishes with zero restarts
+       (its step counter never rewinds).
+    B. **Restore baseline**: the classic 2 -> 1 drill (same plan, same
+       chaos point) on the legacy drain -> re-rendezvous -> checkpoint
+       -restore path; its resize seconds are the denominator of the
+       ``speedup_vs_restore`` headline (target: >= 10x).
+    C. **Parity child**: an in-process 4 -> 2 -> 4 lockstep run
+       (``--live-parity-child``) whose loss trajectory must match a
+       never-resized reference step for step — the proof that a live
+       relayout changes WHERE state lives, not what the program computes.
+    """
+    import copy
+    import shutil
+
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.master.job_master import JobMaster
+
+    os.makedirs(args.workdir, exist_ok=True)
+
+    # -- phase A: live 2 -> 1 (virtual-mesh fold, no restart) -----------------
+    ckpt = os.path.join(args.workdir, "ckpt_live")
+    shutil.rmtree(ckpt, ignore_errors=True)
+    master = JobMaster(
+        num_nodes=2, min_nodes=1,
+        heartbeat_timeout=8.0, max_relaunches=10**6,
+    )
+    master.CONTROL_LOOP_INTERVAL = 2.0
+    port = master.start()
+    base_env = _bench_env(args)
+    base_env["DLROVER_TPU_SKIP_JAX_INIT"] = "1"
+    base_env["DLROVER_TPU_JOB"] = f"live{os.getpid()}"
+    drill_plan = f"preempt.notice:error@{args.drill_preempt_hit}"
+    faults.parse_plan(drill_plan)
+
+    def spawn(node_id: int, plan: str = ""):
+        env = dict(base_env)
+        if plan:
+            env[faults.ENV_PLAN] = plan
+            env[faults.ENV_SEED] = str(args.fault_seed)
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--master", f"localhost:{port}",
+            "--nnodes", "1:2", "--node-id", str(node_id),
+            "--max-restarts", "1000",
+            "--monitor-interval", "0.5",
+            "--heartbeat-interval", "2",
+            "--live-relayout",
+            "--save-at-breakpoint",
+            "--checkpoint-dir", ckpt,
+            "--", sys.executable,
+            os.path.join(REPO, "examples", "train_lm.py"),
+            "--steps", str(args.steps), "--ckpt-every", "10",
+            "--checkpoint-dir", ckpt,
+            "--layers", "1", "--d-model", "64", "--heads", "2",
+            "--seq-len", "64", "--batch-size", "4",
+            "--step-sleep", str(args.step_sleep),
+            "--ref-world", "2", "--live-relayout", "--lockstep-data",
+        ]
+        return subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    t_start = time.monotonic()
+    survivor = spawn(0)
+    victim = spawn(1, drill_plan)
+    relayout_step = -1
+    completed = False
+    deadline = t_start + args.steps * max(args.step_sleep, 0.1) * 6 + 600
+    while time.monotonic() < deadline:
+        sm = master.speed_monitor
+        if (
+            relayout_step < 0
+            and sm.resize_ledger()["by_reason"].get("relayout", 0) > 0
+        ):
+            relayout_step = sm.global_step
+            print(f"[live] relayout booked at step {relayout_step}",
+                  flush=True)
+        if victim is not None and victim.poll() is not None:
+            print(f"[live] node 1 drained (rc {victim.returncode})",
+                  flush=True)
+            victim = None
+        rc = survivor.poll()
+        if rc is not None:
+            # No reprovision here: a survivor restart IS a drill failure
+            # (the live path's whole point is that it never restarts).
+            completed = rc == 0
+            break
+        time.sleep(0.5)
+    for proc in (survivor, victim):
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+
+    sm = master.speed_monitor
+    resize = sm.resize_ledger()
+    relayout_s = resize["by_kind"].get("relayout", 0.0)
+    relayouts = resize["by_reason"].get("relayout", 0)
+    fallbacks = resize["by_reason"].get("relayout_failed", 0)
+    survivor_restarts = master.timeline.restart_count(0)
+    live_completed = completed and sm.global_step >= args.steps
+    # The survivor's step counter never rewinds unless it restarts, so a
+    # zero-restart completed run lost zero steps to the resize.
+    steps_lost = 0 if live_completed and survivor_restarts == 0 else -1
+    live = {
+        "completed": live_completed,
+        "final_step": sm.global_step,
+        "target_steps": args.steps,
+        "relayout_step": relayout_step,
+        "relayouts": relayouts,
+        "relayout_fallbacks": fallbacks,
+        "relayout_s": round(relayout_s, 4),
+        "survivor_restarts": survivor_restarts,
+        "steps_lost": steps_lost,
+        "resizes_by_reason": resize["by_reason"],
+        "resize_s_by_kind": {
+            k: round(v, 4) for k, v in resize["by_kind"].items()
+        },
+        "goodput": round(sm.goodput(), 4),
+        "fault_plan": drill_plan,
+    }
+    master.stop()
+    print(f"[live] phase A done: {json.dumps(live)}", flush=True)
+
+    # -- phase B: classic restore drill (the denominator) ---------------------
+    b_args = copy.copy(args)
+    b_args.out = os.path.join(args.workdir, "restore_drill.json")
+    run_resize_drill(b_args)
+    with open(b_args.out) as f:
+        restore = json.load(f)["detail"]
+    restore_resize_s = restore.get("resize_s", 0.0)
+
+    # -- phase C: in-process 4 -> 2 -> 4 lockstep parity ----------------------
+    parity_out = os.path.join(args.workdir, "live_parity.json")
+    c_env = _bench_env(args)
+    c_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    c_env["DLROVER_TPU_JOB"] = f"parity{os.getpid()}"
+    c_env.pop("DLROVER_TPU_SKIP_JAX_INIT", None)
+    c_rc = subprocess.call(
+        [sys.executable, os.path.abspath(__file__),
+         "--live-parity-child", "--out", parity_out],
+        env=c_env,
+    )
+    parity = {"ok": False, "rc": c_rc}
+    if os.path.exists(parity_out):
+        with open(parity_out) as f:
+            parity = json.load(f)
+
+    speedup = restore_resize_s / max(relayout_s, 1e-9)
+    ok = (
+        live_completed
+        and steps_lost == 0
+        and relayouts >= 1
+        and fallbacks == 0
+        and relayout_s > 0.0
+        and restore_resize_s >= 10.0 * relayout_s
+        and bool(parity.get("ok"))
+    )
+    result = {
+        "metric": "live relayout vs restore-path resize",
+        "value": round(relayout_s * 1000.0, 3),
+        "unit": "ms (in-memory re-layout, vs restore seconds)",
+        "detail": {
+            "ok": ok,
+            "live": live,
+            "restore": {
+                "completed": restore.get("completed"),
+                "resize_s": restore_resize_s,
+                "steps_lost": restore.get("steps_lost"),
+                "drain_s": restore.get("drain_s"),
+            },
+            "speedup_vs_restore": round(speedup, 1),
+            "parity": parity,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def run_live_parity_child(args) -> int:
+    """4 -> 2 -> 4 lockstep parity (in-process; spawned by the live drill).
+
+    One trainer starts on a reference world of 4, folds to 2 at step 4,
+    fans back to 4 at step 8; a second never-resized trainer consumes the
+    identical batch stream.  Because programs compile against the logical
+    mesh, tokens/step and the optimizer trajectory are resize-invariant —
+    the only drift allowed is grad-accum fp reassociation (~1e-5 rel).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    os.environ.setdefault("DLROVER_TPU_JOB", f"parity{os.getpid()}")
+    os.environ.pop("DLROVER_TPU_SKIP_JAX_INIT", None)
+    import numpy as np
+
+    import jax
+    from dlrover_tpu.models.transformer import TransformerConfig
+    from dlrover_tpu.trainer.elastic_trainer import (
+        ElasticTrainer,
+        TrainerConfig,
+    )
+
+    steps = 12
+    mc = TransformerConfig(
+        num_layers=1, d_model=64, num_heads=2,
+        vocab_size=256, max_seq_len=32,
+    )
+    rng = np.random.default_rng(0)
+    batches = [
+        {
+            "inputs": rng.integers(0, 256, (16, 32), dtype=np.int32),
+            "targets": rng.integers(0, 256, (16, 32), dtype=np.int32),
+        }
+        for _ in range(steps)
+    ]
+
+    def mk():
+        return ElasticTrainer(
+            mc,
+            TrainerConfig(
+                global_batch_size=16, seq_len=32,
+                optimizer="sgd", learning_rate=1e-2,
+                grad_accum=1, grad_accum_ref_world=4, world=4,
+                report_every=1000, numeric_checks=False,
+            ),
+            client=None,
+        )
+
+    def losses_of(trainer, schedule):
+        losses = []
+
+        def on_step(step, metrics):
+            losses.append(float(jax.device_get(metrics["loss"])))
+
+        relayout_ms = []
+        at = 0
+        for world, until in schedule:
+            if trainer.vmesh.physical_world != world:
+                d = trainer.apply_world_change(world)
+                if not d.get("ok") or d.get("fallback"):
+                    raise RuntimeError(f"relayout failed: {d}")
+                relayout_ms.append(round(d["relayout_s"] * 1000.0, 3))
+            trainer.fit(iter(batches[at:until]), max_steps=until,
+                        on_step=on_step)
+            at = until
+        return losses, relayout_ms
+
+    resized = mk()
+    prewarm = resized.prewarm_worlds([2, 4], aot=True)
+    live, relayout_ms = losses_of(
+        resized, [(4, 4), (2, 8), (4, steps)]
+    )
+    ref, _ = losses_of(mk(), [(4, steps)])
+    rel_err = max(
+        abs(a - b) / max(abs(b), 1e-9) for a, b in zip(live, ref)
+    )
+    res = {
+        "ok": len(live) == steps and rel_err < 5e-5,
+        "schedule": "4->2->4",
+        "steps": steps,
+        "max_rel_err": rel_err,
+        "relayout_ms": relayout_ms,
+        "prewarm_grad_accum": {str(k): v for k, v in prewarm.items()},
+    }
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    return 0 if res["ok"] else 1
 
 
 def run_sdc_drill(args) -> int:
@@ -480,6 +762,16 @@ def main() -> int:
                          "and node 0's survivor world resumes from the "
                          "cross-world-restored checkpoint; reports drain_s "
                          "/ resize_s / steps_lost")
+    ap.add_argument("--live-relayout", action="store_true",
+                    help="virtual-mesh variant of the resize drill: both "
+                         "agents run --live-relayout, the survivor folds "
+                         "its logical mesh in place (ms) instead of "
+                         "restarting into a checkpoint restore (s); also "
+                         "runs the classic restore drill as the speedup "
+                         "denominator and a 4->2->4 in-process lockstep "
+                         "parity child; writes one combined artifact")
+    ap.add_argument("--live-parity-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--drill-preempt-hit", type=int, default=20,
                     help="preempt.notice seam hit at which node 1's notice "
                          "fires (the monitor probes ~1/s, so this is "
@@ -500,6 +792,10 @@ def main() -> int:
                          "flips (hit N = the N-th digest check, i.e. step "
                          "N * sdc-check-every)")
     args = ap.parse_args()
+    if args.live_parity_child:
+        return run_live_parity_child(args)
+    if args.live_relayout:
+        return run_live_relayout_drill(args)
     if args.resize_drill:
         return run_resize_drill(args)
     if args.sdc_drill:

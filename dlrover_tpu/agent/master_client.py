@@ -239,6 +239,17 @@ class MasterClient:
             self.node_id, tuple(events), dropped
         ))
 
+    def serve_submit(self, submit: msg.ServeSubmit) -> msg.ServeTicket:
+        """One generation request through the master's serving front door
+        (requires a ``ServeFrontend`` wired into the servicer)."""
+        return self.report(submit).payload
+
+    def serve_poll(self, uid: str) -> msg.ServeStatus:
+        return self.get(msg.ServePoll(uid=uid)).payload
+
+    def serve_cancel(self, uid: str) -> msg.ServeStatus:
+        return self.report(msg.ServeCancel(uid=uid)).payload
+
     def get_metrics_text(self) -> str:
         """The master's Prometheus-style exposition (render_metrics)."""
         return self.get(msg.MetricsRequest()).payload

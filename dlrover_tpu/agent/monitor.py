@@ -125,11 +125,15 @@ class ResourceMonitor:
                "device_mem_gb": 0.0, "device_util": 0.0}
         if self._metrics_file and os.path.exists(self._metrics_file):
             try:
+                faults.fire(
+                    "storage.read",
+                    path=os.path.basename(self._metrics_file),
+                )
                 with open(self._metrics_file) as f:
                     device = json.load(f)
                 out["device_mem_gb"] = float(device.get("device_mem_gb", 0.0))
                 out["device_util"] = float(device.get("device_util", 0.0))
-            except (OSError, ValueError):
+            except (OSError, ValueError, faults.FaultInjected):
                 pass
         return out
 

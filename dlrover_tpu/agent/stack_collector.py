@@ -26,6 +26,7 @@ import signal
 import time
 from typing import Optional
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 
 ENV_STACK_FILE = "DLROVER_TPU_STACK_FILE"
@@ -87,10 +88,11 @@ def collect_stacks(pid: int, path: str, timeout_s: float = 3.0) -> str:
                 # faulthandler writes the whole dump in one go; a short
                 # settle covers the multi-thread case.
                 time.sleep(0.1)
+                faults.fire("storage.read", path=os.path.basename(path))
                 with open(path, errors="replace") as f:
                     f.seek(before)
                     return f.read()
-        except OSError:
+        except (OSError, faults.FaultInjected):
             pass
         time.sleep(0.05)
     return (

@@ -300,12 +300,15 @@ class ElasticAgent:
         if not self._log_path or not os.path.exists(self._log_path):
             return ""
         try:
+            faults.fire(
+                "storage.read", path=os.path.basename(self._log_path)
+            )
             with open(self._log_path, "rb") as f:
                 f.seek(0, os.SEEK_END)
                 f.seek(max(0, f.tell() - 16384))
                 lines = f.read().decode(errors="replace").splitlines()
             return "\n".join(lines[-n:])
-        except OSError:
+        except (OSError, faults.FaultInjected):
             return ""
 
     def _start_workers(self) -> Dict:

@@ -9,9 +9,10 @@ can exercise it.  That only works if the convention holds — a raw
 can never reach, and the first time it breaks is in production.  This
 rule turns the convention into a checked property.
 
-A raw I/O call (write-mode ``open``, ``os.replace``/``os.rename``,
-``shutil.*``, ``socket.*`` connection constructors, ``urlopen``,
-``requests.*``) inside the fault-handling tiers (``agent/``,
+A raw I/O call (write-mode ``open``, read-mode ``open`` of anything but
+a ``/proc/`` literal, ``os.replace``/``os.rename``, ``shutil.*``,
+``socket.*`` connection constructors, ``urlopen``, ``requests.*``)
+inside the fault-handling tiers (``agent/``,
 ``master/``, ``checkpoint/``, ``data/``) fires unless its enclosing
 function also fires a *registered* seam — the seam registry is parsed
 from ``common/faults.py``'s ``KNOWN_SEAMS`` tuple, so inventing an
@@ -41,6 +42,7 @@ FALLBACK_SEAMS: Tuple[str, ...] = (
     "rpc.report", "rpc.get", "storage.write", "storage.read",
     "saver.persist", "saver.flush", "backend.init", "coworker.fetch",
     "preempt.notice", "rdzv.join", "sdc.flip", "serve.admit",
+    "serve.rpc", "serve.swap", "replica.death",
 )
 
 #: Dotted call names that are raw I/O regardless of arguments.
@@ -109,10 +111,29 @@ def _open_write_mode(call: ast.Call) -> bool:
     return bool(_WRITE_MODE_CHARS & set(mode.value))
 
 
+def _open_read_mode(call: ast.Call) -> bool:
+    """True for a read ``open`` (mode-less or a mode literal with no
+    mutating char) of anything but a ``/proc/`` literal — procfs never
+    models remote-storage failure, but every other read path does (an
+    unreadable state file, a missing shard, a torn checkpoint), and PR 13
+    closed the ``storage.read`` gap those were hiding behind."""
+    if jaxast.call_name(call) != "open":
+        return False
+    if _open_write_mode(call):
+        return False
+    target = call.args[0] if call.args else None
+    if isinstance(target, ast.Constant) and isinstance(target.value, str):
+        if target.value.startswith("/proc/"):
+            return False
+    return True
+
+
 def raw_io_kind(call: ast.Call) -> str:
     """Which raw-I/O family ``call`` belongs to, or "" if none."""
     if _open_write_mode(call):
         return "open-for-write"
+    if _open_read_mode(call):
+        return "open-for-read"
     name = jaxast.call_name(call)
     if not name:
         return ""

@@ -11,6 +11,13 @@ world-resizing cheap.
 One engine per host process (TPU model: one process drives all local chips),
 so there is exactly one shm arena per host instead of the reference's
 per-local-rank arenas.
+
+The durable-storage read half (discover world groups, verify digests and
+shard crcs, merge records across any saved world, materialize) lives in
+:class:`StorageStepReader` — it needs no shm arena, queue or lock, so
+read-only consumers (the serving plane's weight hot-swap) can use it without
+paying for a trainer's IPC surface.  ``CheckpointEngine`` extends it with
+the shm save path and the cross-host restore agreement.
 """
 
 from __future__ import annotations
@@ -123,194 +130,31 @@ def status_name(host_index: int) -> str:
     return f"ckpt_status_h{host_index}"
 
 
-class CheckpointEngine:
-    """save_to_memory / save_to_storage / load for one host process."""
+class StorageStepReader:
+    """Read-and-verify committed checkpoint steps from durable storage.
+
+    Self-contained any-n→m reshard reader: discovers the saved world
+    group(s) from the ``host_{i}_of_{n}.meta`` files actually present,
+    verifies digest sidecars and per-shard crcs, merges shard records
+    across hosts, and materializes under any target sharding.  Holds no
+    shm arena, no event queue, no lock — safe to construct in processes
+    that only ever *read* checkpoints (``ServingEngine.swap_weights``).
+    """
 
     def __init__(
         self,
         checkpoint_dir: str,
         storage: Optional[CheckpointStorage] = None,
-        host_index: Optional[int] = None,
         num_hosts: Optional[int] = None,
-        local_saver: bool = False,
-        agree_step_fn: Optional[Callable[[int], int]] = None,
-        agree_min_fn: Optional[Callable[[int], int]] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.storage = storage or get_checkpoint_storage()
         self.layout = CheckpointDirLayout(checkpoint_dir)
-        self.host_index = (
-            default_host_index() if host_index is None else host_index
-        )
-        self._agree_step_fn = agree_step_fn
-        self._agree_min_fn = agree_min_fn
         self.num_hosts = (
             jax.process_count() if num_hosts is None else num_hosts
         )
-        self._shm = SharedMemoryHandler(shm_name(self.host_index))
-        self._saver = None
-        if local_saver:
-            # Standalone mode (no agent process): run the async saver as an
-            # in-process daemon thread, same contract as the agent-side saver.
-            from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
-
-            self._saver = AsyncCheckpointSaver(
-                checkpoint_dir,
-                storage=self.storage,
-                host_index=self.host_index,
-                num_hosts=self.num_hosts,
-            )
-            self._saver.start()
-        self._event_queue = SharedQueue(
-            event_queue_name(self.host_index), create=False
-        )
-        self._lock = SharedLock(lock_name(self.host_index), create=False)
-        self._status = SharedDict(status_name(self.host_index), create=False)
-        self._latest_memory_step = -1
-        self._latest_storage_step = -1
         # ``extra`` sidecar of the most recently restored checkpoint.
         self.last_restored_extra: Dict[str, Any] = {}
-
-    # -- save -----------------------------------------------------------------
-
-    def save_to_memory(
-        self, step: int, state: Any, extra: Optional[Dict[str, Any]] = None
-    ) -> bool:
-        """Pack ``state`` into shm.  Skips (returns False) if the saver is
-        mid-persist — never blocks training on storage I/O."""
-        if not self._lock.acquire(blocking=False):
-            logger.info(
-                "step %d: shm busy (saver persisting); skip memory save", step
-            )
-            return False
-        try:
-            t0 = time.monotonic()
-            self._shm.save_state_dict(state, step, extra)
-            self._latest_memory_step = step
-            logger.info(
-                "step %d: saved to shm in %.3fs", step, time.monotonic() - t0
-            )
-            return True
-        finally:
-            self._lock.release()
-
-    def save_to_storage(
-        self, step: int, state: Any, extra: Optional[Dict[str, Any]] = None
-    ) -> bool:
-        saved = self.save_to_memory(step, state, extra)
-        if saved:
-            self._latest_storage_step = step
-            self._event_queue.put(
-                CheckpointEvent(CheckpointEventType.SAVE, step)
-            )
-        return saved
-
-    # -- load -----------------------------------------------------------------
-
-    def load(
-        self,
-        shardings: Any = None,
-        treedef: Any = None,
-    ):
-        """Restore the newest *world-agreed* state: shm if it holds the agreed
-        step, committed storage otherwise.
-
-        Hosts must restore the same step — after an elastic restart a
-        surviving host may hold a newer shm step than a replaced host can see
-        on storage; resuming from different steps silently diverges
-        replicated state.  The candidate step is therefore agreed across
-        hosts (min over each host's best available step) before
-        materializing anything.
-
-        Returns ``(step, state)`` where ``state`` is a pytree matching
-        ``treedef`` (or a flat ``{path: array}`` dict when no treedef) with
-        leaves ``device_put`` under ``shardings`` when given.
-        """
-        meta = self._shm.load_meta()
-        shm_ok = meta is not None and self._all_local(meta)
-        shm_step = meta.step if shm_ok else -1
-        known = [shm_step] + self.layout.committed_steps(self.storage)
-        # Walk candidates newest-first, re-agreeing after each failure so a
-        # corrupt newest step degrades to the next intact one on EVERY host.
-        # Every iteration runs exactly two collectives on every host — the
-        # step agreement and the outcome agreement — so hosts whose local
-        # attempt succeeded keep participating until the whole world
-        # succeeds (a lone host retrying would hang in a dead collective).
-        upper: Optional[int] = None
-        while True:
-            local_best = max(
-                (s for s in known if upper is None or s < upper), default=-1
-            )
-            step = self._agree_restore_step(local_best)
-            if step < 0:
-                return -1, None
-            if upper is not None and step >= upper:
-                # Agreement is not making progress (custom agree_fn pinned to
-                # a dead step) — fail rather than spin.
-                return -1, None
-            if shm_ok and shm_step == step:
-                logger.info("restoring step %d from shm", step)
-                arrays = {
-                    t.path: assemble_tensor(
-                        t, lambda r: self._shm.load_block(meta, r)
-                    )
-                    for t in meta.tensors
-                }
-                result = self._materialize(arrays, meta, shardings, treedef)
-            else:
-                result = self._load_step_from_storage(step, shardings, treedef)
-            world_ok = self._agree_min(1 if result is not None else 0) > 0
-            if world_ok:
-                return step, result
-            logger.warning(
-                "agreed step %d not restorable on every host; trying older "
-                "steps (local attempt %s)",
-                step, "succeeded" if result is not None else "failed",
-            )
-            upper = step
-
-    def _agree_restore_step(self, candidate: int) -> int:
-        """Agree the restore step across the world (min of candidates).
-
-        Uses the injected ``agree_step_fn`` when given (tests, custom
-        fabrics); otherwise the shared min-agreement fabric.
-        """
-        if self._agree_step_fn is not None:
-            return self._agree_step_fn(candidate)
-        agreed = self._agree_min(candidate)
-        if agreed != candidate:
-            logger.info(
-                "restore step agreed across hosts: %d (local best %d)",
-                agreed, candidate,
-            )
-        return agreed
-
-    def _agree_min(self, value: int) -> int:
-        """Min-reduce ``value`` across the restore world.
-
-        Falls back to the local value — loudly — when the collective cannot
-        run (jax.distributed not initialized, or the agent's ``num_hosts``
-        disagreeing with ``jax.process_count()``): silently no-opping here
-        would disable the divergent-restore guard exactly in the degraded
-        states it exists for.
-        """
-        if self._agree_min_fn is not None:
-            return self._agree_min_fn(value)
-        if self.num_hosts > 1 and jax.process_count() == self.num_hosts:
-            from jax.experimental import multihost_utils
-
-            values = multihost_utils.process_allgather(
-                np.asarray(value, np.int64)
-            )
-            return int(np.min(values))
-        if self.num_hosts > 1:
-            logger.error(
-                "restore agreement DEGRADED to local-only: num_hosts=%d but "
-                "jax.process_count()=%d — cross-host divergent-restore "
-                "protection is OFF for this restore",
-                self.num_hosts, jax.process_count(),
-            )
-        return value
 
     def load_from_storage(
         self,
@@ -577,15 +421,198 @@ class CheckpointEngine:
                     return False
         return True
 
-    def _all_local(self, meta: CheckpointMeta) -> bool:
-        return all(t.local_covers_global for t in meta.tensors)
-
     def _materialize(self, arrays, meta, shardings, treedef):
         # Surface the checkpoint's small non-array sidecar to the caller
         # (trainer knob booking: grad_accum/reference world, rng, config)
         # without widening every load path's (step, state) return.
         self.last_restored_extra = dict(getattr(meta, "extra", None) or {})
         return materialize_records(arrays, meta, shardings, treedef)
+
+
+class CheckpointEngine(StorageStepReader):
+    """save_to_memory / save_to_storage / load for one host process."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+        host_index: Optional[int] = None,
+        num_hosts: Optional[int] = None,
+        local_saver: bool = False,
+        agree_step_fn: Optional[Callable[[int], int]] = None,
+        agree_min_fn: Optional[Callable[[int], int]] = None,
+    ):
+        super().__init__(checkpoint_dir, storage=storage, num_hosts=num_hosts)
+        self.host_index = (
+            default_host_index() if host_index is None else host_index
+        )
+        self._agree_step_fn = agree_step_fn
+        self._agree_min_fn = agree_min_fn
+        self._shm = SharedMemoryHandler(shm_name(self.host_index))
+        self._saver = None
+        if local_saver:
+            # Standalone mode (no agent process): run the async saver as an
+            # in-process daemon thread, same contract as the agent-side saver.
+            from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+            self._saver = AsyncCheckpointSaver(
+                checkpoint_dir,
+                storage=self.storage,
+                host_index=self.host_index,
+                num_hosts=self.num_hosts,
+            )
+            self._saver.start()
+        self._event_queue = SharedQueue(
+            event_queue_name(self.host_index), create=False
+        )
+        self._lock = SharedLock(lock_name(self.host_index), create=False)
+        self._status = SharedDict(status_name(self.host_index), create=False)
+        self._latest_memory_step = -1
+        self._latest_storage_step = -1
+
+    # -- save -----------------------------------------------------------------
+
+    def save_to_memory(
+        self, step: int, state: Any, extra: Optional[Dict[str, Any]] = None
+    ) -> bool:
+        """Pack ``state`` into shm.  Skips (returns False) if the saver is
+        mid-persist — never blocks training on storage I/O."""
+        if not self._lock.acquire(blocking=False):
+            logger.info(
+                "step %d: shm busy (saver persisting); skip memory save", step
+            )
+            return False
+        try:
+            t0 = time.monotonic()
+            self._shm.save_state_dict(state, step, extra)
+            self._latest_memory_step = step
+            logger.info(
+                "step %d: saved to shm in %.3fs", step, time.monotonic() - t0
+            )
+            return True
+        finally:
+            self._lock.release()
+
+    def save_to_storage(
+        self, step: int, state: Any, extra: Optional[Dict[str, Any]] = None
+    ) -> bool:
+        saved = self.save_to_memory(step, state, extra)
+        if saved:
+            self._latest_storage_step = step
+            self._event_queue.put(
+                CheckpointEvent(CheckpointEventType.SAVE, step)
+            )
+        return saved
+
+    # -- load -----------------------------------------------------------------
+
+    def load(
+        self,
+        shardings: Any = None,
+        treedef: Any = None,
+    ):
+        """Restore the newest *world-agreed* state: shm if it holds the agreed
+        step, committed storage otherwise.
+
+        Hosts must restore the same step — after an elastic restart a
+        surviving host may hold a newer shm step than a replaced host can see
+        on storage; resuming from different steps silently diverges
+        replicated state.  The candidate step is therefore agreed across
+        hosts (min over each host's best available step) before
+        materializing anything.
+
+        Returns ``(step, state)`` where ``state`` is a pytree matching
+        ``treedef`` (or a flat ``{path: array}`` dict when no treedef) with
+        leaves ``device_put`` under ``shardings`` when given.
+        """
+        meta = self._shm.load_meta()
+        shm_ok = meta is not None and self._all_local(meta)
+        shm_step = meta.step if shm_ok else -1
+        known = [shm_step] + self.layout.committed_steps(self.storage)
+        # Walk candidates newest-first, re-agreeing after each failure so a
+        # corrupt newest step degrades to the next intact one on EVERY host.
+        # Every iteration runs exactly two collectives on every host — the
+        # step agreement and the outcome agreement — so hosts whose local
+        # attempt succeeded keep participating until the whole world
+        # succeeds (a lone host retrying would hang in a dead collective).
+        upper: Optional[int] = None
+        while True:
+            local_best = max(
+                (s for s in known if upper is None or s < upper), default=-1
+            )
+            step = self._agree_restore_step(local_best)
+            if step < 0:
+                return -1, None
+            if upper is not None and step >= upper:
+                # Agreement is not making progress (custom agree_fn pinned to
+                # a dead step) — fail rather than spin.
+                return -1, None
+            if shm_ok and shm_step == step:
+                logger.info("restoring step %d from shm", step)
+                arrays = {
+                    t.path: assemble_tensor(
+                        t, lambda r: self._shm.load_block(meta, r)
+                    )
+                    for t in meta.tensors
+                }
+                result = self._materialize(arrays, meta, shardings, treedef)
+            else:
+                result = self._load_step_from_storage(step, shardings, treedef)
+            world_ok = self._agree_min(1 if result is not None else 0) > 0
+            if world_ok:
+                return step, result
+            logger.warning(
+                "agreed step %d not restorable on every host; trying older "
+                "steps (local attempt %s)",
+                step, "succeeded" if result is not None else "failed",
+            )
+            upper = step
+
+    def _agree_restore_step(self, candidate: int) -> int:
+        """Agree the restore step across the world (min of candidates).
+
+        Uses the injected ``agree_step_fn`` when given (tests, custom
+        fabrics); otherwise the shared min-agreement fabric.
+        """
+        if self._agree_step_fn is not None:
+            return self._agree_step_fn(candidate)
+        agreed = self._agree_min(candidate)
+        if agreed != candidate:
+            logger.info(
+                "restore step agreed across hosts: %d (local best %d)",
+                agreed, candidate,
+            )
+        return agreed
+
+    def _agree_min(self, value: int) -> int:
+        """Min-reduce ``value`` across the restore world.
+
+        Falls back to the local value — loudly — when the collective cannot
+        run (jax.distributed not initialized, or the agent's ``num_hosts``
+        disagreeing with ``jax.process_count()``): silently no-opping here
+        would disable the divergent-restore guard exactly in the degraded
+        states it exists for.
+        """
+        if self._agree_min_fn is not None:
+            return self._agree_min_fn(value)
+        if self.num_hosts > 1 and jax.process_count() == self.num_hosts:
+            from jax.experimental import multihost_utils
+
+            values = multihost_utils.process_allgather(
+                np.asarray(value, np.int64)
+            )
+            return int(np.min(values))
+        if self.num_hosts > 1:
+            logger.error(
+                "restore agreement DEGRADED to local-only: num_hosts=%d but "
+                "jax.process_count()=%d — cross-host divergent-restore "
+                "protection is OFF for this restore",
+                self.num_hosts, jax.process_count(),
+            )
+        return value
+
+    def _all_local(self, meta: CheckpointMeta) -> bool:
+        return all(t.local_covers_global for t in meta.tensors)
 
     def wait_saver(self, timeout: float = 600.0):
         """Block until every storage save this engine requested is durable.

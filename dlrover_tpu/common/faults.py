@@ -33,7 +33,8 @@ Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``rpc.report``, ``rpc.get``, ``storage.write``, ``storage.read``,
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
 ``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``,
-``tpu.api``, ``relayout.apply``.
+``tpu.api``, ``relayout.apply``, ``serve.rpc``, ``serve.swap``,
+``replica.death``.
 """
 
 from __future__ import annotations
@@ -86,6 +87,21 @@ KNOWN_SEAMS = (
     # checkpoint restore, booked as resizes_by_reason["relayout_failed"].
     # Delay kinds stretch the relayout window the resize ledger measures.
     "relayout.apply",
+    # Serving front-door seam: fires on every submit/poll/cancel the RPC
+    # front door handles — error kinds model a flaky client link (the
+    # caller's RetryPolicy re-issues), delay kinds model a slow ingress
+    # that eats into per-request deadlines.
+    "serve.rpc",
+    # Weight hot-swap seam: fires inside ServingEngine.swap_weights after
+    # the new params land on device; a fired error tells the engine to
+    # corrupt one mantissa bit of the swapped tree (state_digest's
+    # flipper) — modeling a torn/corrupt weight push that only the digest
+    # check can see.  The engine must detect it and roll back.
+    "serve.swap",
+    # Replica-death seam: fires on the fleet's per-replica health probe; a
+    # fired error IS the scripted replica crash — the fleet must requeue
+    # that replica's in-flight requests onto survivors with zero lost.
+    "replica.death",
 )
 
 

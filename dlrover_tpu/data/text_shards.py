@@ -29,6 +29,7 @@ class TextShardReader:
         self.path = path
         self._index_path = index_path or (path + self.INDEX_SUFFIX)
         self._offsets = self._load_or_build_index()
+        faults.fire("storage.read", path=os.path.basename(path))
         self._file = open(path, "rb")
 
     @property

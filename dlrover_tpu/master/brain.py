@@ -61,10 +61,13 @@ class BrainService:
         if not os.path.exists(self.history_path):
             return
         try:
+            faults.fire(
+                "storage.read", path=os.path.basename(self.history_path)
+            )
             with open(self.history_path) as f:
                 raw = json.load(f)
             self._records = [JobRecord(**r) for r in raw]
-        except (OSError, ValueError, TypeError) as e:
+        except (OSError, ValueError, TypeError, faults.FaultInjected) as e:
             logger.warning("brain history unreadable (%s); starting empty", e)
 
     def persist_metrics(self, record: JobRecord):

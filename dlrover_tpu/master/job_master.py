@@ -148,6 +148,12 @@ class JobMaster:
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
+    def attach_serve_frontend(self, frontend):
+        """Wire a serving front door (serving/frontend.py) into the
+        servicer: ServeSubmit/ServePoll/ServeCancel become live RPCs on
+        the master's existing 2-RPC transport."""
+        self.servicer.serve_frontend = frontend
+
     def prepare(self):
         self._server, self.port = start_master_server(self.servicer, self.port)
 

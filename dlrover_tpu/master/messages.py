@@ -347,6 +347,58 @@ class BatchPayload:
     error: str = ""
 
 
+# -- serving front door -------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServeSubmit:
+    """One generation request through the RPC front door.  The prompt is
+    a tuple of token ids (plain builtins only — the restricted unpickler
+    admits no numpy); ``deadline_s`` is the client's end-to-end budget,
+    which the admission controller sheds against."""
+
+    uid: str
+    prompt: Tuple[int, ...]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: int = -1
+    deadline_s: float = 30.0
+
+
+@dataclasses.dataclass
+class ServeTicket:
+    """Submit verdict: accepted into the bounded queue, or fast-rejected
+    (``reason`` = "shed" | "queue_full" | "no_fleet") with the predicted
+    wait that triggered the shed."""
+
+    uid: str
+    accepted: bool
+    reason: str = ""
+    predicted_wait_s: float = 0.0
+
+
+@dataclasses.dataclass
+class ServePoll:
+    uid: str
+
+
+@dataclasses.dataclass
+class ServeStatus:
+    """Poll answer.  ``state`` walks pending -> done; ``tokens`` are the
+    generated ids once done; shed/cancelled/unknown are terminal."""
+
+    uid: str
+    state: str = "unknown"
+    tokens: Tuple[int, ...] = ()
+    latency_s: float = 0.0
+
+
+@dataclasses.dataclass
+class ServeCancel:
+    uid: str
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     """Deserializer for the control-plane wire format.
 

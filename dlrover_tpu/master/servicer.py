@@ -39,6 +39,7 @@ class MasterServicer:
         metrics=None,
         timeline=None,
         auto_scaler=None,
+        serve_frontend=None,
     ):
         self.rdzv_managers = rdzv_managers or {}
         self.task_manager = task_manager
@@ -49,6 +50,10 @@ class MasterServicer:
         self.metrics = metrics
         self.timeline = timeline
         self.auto_scaler = auto_scaler
+        # Optional serving front door (serving/frontend.py): when wired,
+        # submit/poll/cancel ride the same 2-RPC transport as the rest of
+        # the control plane — no second server, no new wire format.
+        self.serve_frontend = serve_frontend
         from dlrover_tpu.master.sync_service import SyncService
 
         self.sync_service = SyncService()
@@ -68,6 +73,7 @@ class MasterServicer:
             msg.ClusterVersion: self._cluster_version,
             msg.MetricsRequest: self._get_metrics_text,
             msg.TimelineRequest: self._get_timeline,
+            msg.ServePoll: self._serve_poll,
         }
         self._report_handlers: Dict[Type, Callable] = {
             msg.JoinRendezvous: self._join_rendezvous,
@@ -84,6 +90,8 @@ class MasterServicer:
             msg.ShardCheckpoint: self._restore_shard_checkpoint,
             msg.TelemetryEvents: self._report_telemetry,
             msg.DigestReport: self._report_digest,
+            msg.ServeSubmit: self._serve_submit,
+            msg.ServeCancel: self._serve_cancel,
         }
 
     # -- RPC entry points -----------------------------------------------------
@@ -329,6 +337,17 @@ class MasterServicer:
                         str(attrs.get("kind", "")),
                         float(duration_s or 0.0),
                     )
+                elif name == "serve.swap" and isinstance(attrs, dict):
+                    # Weight hot-swap booking: versioned, with the
+                    # rollback verdict — the serve ledger's swap counters
+                    # (and gauges) come from here.
+                    self.speed_monitor.record_swap(
+                        node,
+                        version=int(attrs.get("version", 0)),
+                        ok=bool(attrs.get("ok", False)),
+                        rolled_back=bool(attrs.get("rolled_back", False)),
+                        seconds=float(duration_s or 0.0),
+                    )
                 elif name == "serve" and isinstance(attrs, dict):
                     # Serving-replica stats snapshot: feeds the serve
                     # ledger behind dlrover_serve_* and the auto-scaler's
@@ -386,6 +405,22 @@ class MasterServicer:
         (ref ``paral_config_tuner.py:30-78``)."""
         config.version = self.paral_config.version + 1
         self.paral_config = config
+
+    # -- serving front door ---------------------------------------------------
+
+    def _require_frontend(self):
+        if self.serve_frontend is None:
+            raise RuntimeError("no serving front door on this master")
+        return self.serve_frontend
+
+    def _serve_submit(self, env: msg.Envelope):
+        return self._require_frontend().submit(env.payload)
+
+    def _serve_poll(self, env: msg.Envelope):
+        return self._require_frontend().poll(env.payload)
+
+    def _serve_cancel(self, env: msg.Envelope):
+        return self._require_frontend().cancel(env.payload)
 
     # -- sync service ---------------------------------------------------------
 

@@ -82,6 +82,13 @@ class SpeedMonitor:
         # ``dlrover_serve_*`` gauges read the aggregate.
         self._serve_stats: Dict[int, Dict[str, float]] = {}
         self._serve_events = 0
+        # Live weight hot-swap ledger ("serve.swap" telemetry events):
+        # newest weights version seen fleet-wide, swap count, and how many
+        # were rolled back on a digest mismatch.
+        self._swaps = 0
+        self._swap_rollbacks = 0
+        self._swap_s_total = 0.0
+        self._weights_version = 0
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -187,6 +194,27 @@ class SpeedMonitor:
                 "tokens": float(tokens),
             }
 
+    def record_swap(
+        self,
+        node_id: int = 0,
+        *,
+        version: int = 0,
+        ok: bool = False,
+        rolled_back: bool = False,
+        seconds: float = 0.0,
+        **_ignored,
+    ):
+        """One live weight hot-swap attempt (a ``serve.swap`` telemetry
+        event).  ``version`` is the replica's post-swap weights version —
+        the ledger keeps the fleet-wide max, so the gauge answers "what
+        weights is the fleet on" without a per-replica query."""
+        with self._lock:
+            self._swaps += 1
+            if rolled_back or not ok:
+                self._swap_rollbacks += 1
+            self._swap_s_total += max(0.0, float(seconds))
+            self._weights_version = max(self._weights_version, int(version))
+
     def serve_ledger(self) -> Dict[str, float]:
         """Fleet aggregate: QPS/requests/tokens/slots sum across replicas,
         latency quantiles take the WORST replica (an SLO is breached when
@@ -206,7 +234,63 @@ class SpeedMonitor:
                 "slots": sum(s["slots"] for s in stats),
                 "requests": sum(s["requests"] for s in stats),
                 "tokens": sum(s["tokens"] for s in stats),
+                "swaps": float(self._swaps),
+                "swap_rollbacks": float(self._swap_rollbacks),
+                "swap_s_total": self._swap_s_total,
+                "weights_version": float(self._weights_version),
             }
+
+    # -- snapshot surfaces (master/state_store.py capture/restore) ------------
+    #
+    # The serve and resize ledgers are counters a Prometheus scraper rates
+    # over time — a master restart zeroing them reads as a counter reset
+    # mid-incident.  These two pairs round-trip exactly the fields the
+    # ``dlrover_serve_*`` / ``dlrover_resize_*`` gauges render.
+
+    def serve_state(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "stats": {k: dict(v) for k, v in self._serve_stats.items()},
+                "events": self._serve_events,
+                "swaps": self._swaps,
+                "swap_rollbacks": self._swap_rollbacks,
+                "swap_s_total": self._swap_s_total,
+                "weights_version": self._weights_version,
+            }
+
+    def restore_serve_state(self, state: Dict[str, object]):
+        with self._lock:
+            for k, v in dict(state.get("stats", {})).items():
+                self._serve_stats[int(k)] = dict(v)
+            self._serve_events = int(state.get("events", 0))
+            self._swaps = int(state.get("swaps", 0))
+            self._swap_rollbacks = int(state.get("swap_rollbacks", 0))
+            self._swap_s_total = float(state.get("swap_s_total", 0.0))
+            self._weights_version = max(
+                self._weights_version, int(state.get("weights_version", 0))
+            )
+
+    def resize_state(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "resizes": self._resizes,
+                "resize_s_total": self._resize_s_total,
+                "by_reason": dict(self._resizes_by_reason),
+                "by_kind": dict(self._resize_s_by_kind),
+            }
+
+    def restore_resize_state(self, state: Dict[str, object]):
+        """An open resize window is deliberately NOT restored: the master
+        that died mid-window cannot know when (or if) the world re-formed,
+        so the conservative read is to drop the open window and keep only
+        the closed totals."""
+        with self._lock:
+            self._resizes = int(state.get("resizes", 0))
+            self._resize_s_total = float(state.get("resize_s_total", 0.0))
+            for k, v in dict(state.get("by_reason", {})).items():
+                self._resizes_by_reason[str(k)] = int(v)
+            for k, v in dict(state.get("by_kind", {})).items():
+                self._resize_s_by_kind[str(k)] = float(v)
 
     def fault_ledger(self) -> Dict[str, object]:
         with self._lock:

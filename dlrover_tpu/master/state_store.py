@@ -68,6 +68,12 @@ class MasterStateStore:
             "datasets": datasets,
             "nodes": nodes,
             "kv": kv,
+            # Monitoring counters a scraper rates over time: losing them to
+            # a master restart reads as a mid-incident counter reset on the
+            # dlrover_serve_* / dlrover_resize_seconds_total{kind=...}
+            # gauges, so they ride the same snapshot.
+            "serve": master.speed_monitor.serve_state(),
+            "resize": master.speed_monitor.resize_state(),
         }
 
     def save(self, master):
@@ -84,9 +90,13 @@ class MasterStateStore:
         if not os.path.exists(self.path):
             return None
         try:
+            # The seam sits inside the try: an injected storage.read error
+            # takes the same unreadable-state -> start-fresh path a torn or
+            # lost state file would, so that path is drillable.
+            faults.fire("storage.read", path=os.path.basename(self.path))
             with open(self.path) as f:
                 return json.load(f)
-        except (OSError, ValueError) as e:
+        except (OSError, ValueError, faults.FaultInjected) as e:
             logger.error("master state unreadable (%s); starting fresh", e)
             return None
 
@@ -131,6 +141,10 @@ class MasterStateStore:
                 master.kv_store.put(key, bytes.fromhex(value))
             except ValueError:
                 continue
+        if state.get("serve"):
+            master.speed_monitor.restore_serve_state(state["serve"])
+        if state.get("resize"):
+            master.speed_monitor.restore_resize_state(state["resize"])
         if state.get("global_step"):
             master.speed_monitor.collect_global_step(
                 state["global_step"], timestamp=time.time()

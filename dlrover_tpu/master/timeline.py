@@ -282,6 +282,13 @@ class JobTimeline:
                   "tokens generated by serving, summed over replicas")
             gauge("dlrover_serve_replicas", serve["replicas"],
                   "serving replicas that have reported stats")
+            gauge("dlrover_serve_swaps_total", serve["swaps"],
+                  "live weight hot-swap attempts reported fleet-wide")
+            gauge("dlrover_serve_swap_rollbacks_total",
+                  serve["swap_rollbacks"],
+                  "hot-swaps rolled back on a digest mismatch")
+            gauge("dlrover_serve_weights_version", serve["weights_version"],
+                  "newest weights version any replica is serving")
             sdc = speed_monitor.sdc_ledger()
             gauge("dlrover_sdc_checks_total", sdc["checks"],
                   "cross-replica state-digest votes performed")

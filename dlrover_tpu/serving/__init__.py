@@ -16,3 +16,8 @@ from dlrover_tpu.serving.engine import (  # noqa: F401
     RequestResult,
     ServingEngine,
 )
+from dlrover_tpu.serving.fleet import (  # noqa: F401
+    NoReplicaError,
+    ReplicaFleet,
+)
+from dlrover_tpu.serving.frontend import ServeFrontend  # noqa: F401

@@ -44,6 +44,7 @@ from dlrover_tpu.rl.generation import SamplingParams
 from dlrover_tpu.serving.bucketing import make_buckets, pad_to_bucket, \
     pick_bucket
 from dlrover_tpu.serving.decode import get_programs
+from dlrover_tpu.serving import hotswap
 
 
 @dataclasses.dataclass
@@ -147,6 +148,11 @@ class ServingEngine:
         self._requests_done = 0
         self._tokens_out = 0
         self._submitted = 0
+        # Weight provenance: bumped by every verified hot-swap; the
+        # version rides the serve.swap telemetry event so the master can
+        # tell which weights each replica is answering with.
+        self.weights_version = 0
+        self._digest_fn = None
 
     # -- admission ------------------------------------------------------------
 
@@ -373,6 +379,114 @@ class ServingEngine:
             occupancy=stats["occupancy"], slots=int(stats["slots"]),
             requests=int(stats["requests"]), tokens=int(stats["tokens"]),
         )
+
+    # -- live weight hot-swap -------------------------------------------------
+
+    def swap_weights(
+        self,
+        checkpoint_dir: str,
+        *,
+        step: Optional[int] = None,
+        storage=None,
+    ) -> Dict[str, object]:
+        """Replace the decode params with a committed checkpoint, live.
+
+        No drain, no recompile: the serving programs take params as
+        *arguments*, so a tree with identical leaf shapes/dtypes swaps in
+        as an assignment between two decode steps — queued requests keep
+        their slots, live slots keep their KV rows, and the trace
+        counters stay flat (asserted by the tier-1 swap test).
+
+        The integrity chain, end to end: the
+        :class:`~dlrover_tpu.checkpoint.engine.StorageStepReader` only
+        yields bytes whose digest sidecar + per-shard crcs verify; the
+        assembled arrays are folded into a host-side reference digest
+        (``hotswap.host_digest``, bitwise the ``state_digest`` fold);
+        after landing, the on-device swapped tree is digested with the
+        PR-9 jitted program and must reproduce the reference.  A mismatch
+        — the ``serve.swap`` Faultline seam injects exactly that by
+        flipping one landed mantissa bit — rolls back to the prior tree,
+        which is retained until the verify passes.  Every outcome books a
+        versioned ``serve.swap`` telemetry event.
+
+        Returns a report dict (``ok``, ``rolled_back``, ``version``,
+        ``step``, ``digest``, ``seconds``); raises ``ValueError`` when
+        the checkpoint cannot map onto the decode params at all (drifted
+        shapes/dtypes — that needs new programs, not a swap) and
+        ``RuntimeError`` when no verifiable step exists.
+        """
+        t0 = time.perf_counter()
+        from dlrover_tpu.checkpoint.engine import StorageStepReader
+        from dlrover_tpu.trainer.state_digest import (
+            _digest_tree, format_digest,
+        )
+
+        reader = StorageStepReader(
+            checkpoint_dir, storage=storage, num_hosts=1
+        )
+        loaded_step, arrays = reader.load_from_storage(step=step)
+        if arrays is None:
+            raise RuntimeError(
+                f"no verifiable committed step in {checkpoint_dir}"
+                + (f" (wanted step {step})" if step is not None else "")
+            )
+        sources = hotswap.map_checkpoint_to_params(arrays, self.params)
+        reference = hotswap.host_digest(sources)
+        _, leaves = hotswap.leaf_paths(self.params)
+        treedef = jax.tree_util.tree_structure(self.params)
+        landed = jax.tree_util.tree_unflatten(treedef, [
+            jax.device_put(src, leaf.sharding)
+            for src, leaf in zip(sources, leaves)
+        ])
+        try:
+            faults.fire("serve.swap", step=loaded_step)
+        except faults.FaultInjected:
+            # The scripted corruption: one flipped bit in the landed tree
+            # (programs untouched) — the digest compare below must catch
+            # it and roll back.
+            landed = hotswap.flip_param_bit(landed)
+        if self._digest_fn is None:
+            self._digest_fn = jax.jit(_digest_tree)
+        prior = self.params
+        self.params = landed
+        device_digest = int(np.asarray(self._digest_fn(self.params)))
+        ok = device_digest == reference
+        rolled_back = False
+        if not ok:
+            # The prior tree was retained exactly for this: corrupted
+            # weights never answer a request.
+            self.params = prior
+            rolled_back = True
+            logger.error(
+                "hot-swap REJECTED: swapped-tree digest %s != checkpoint "
+                "reference %s; rolled back to version %d",
+                format_digest(device_digest), format_digest(reference),
+                self.weights_version,
+            )
+        else:
+            self.weights_version += 1
+            logger.info(
+                "hot-swap: step %d live as weights version %d (digest %s)",
+                loaded_step, self.weights_version,
+                format_digest(device_digest),
+            )
+        seconds = time.perf_counter() - t0
+        telemetry.event(
+            "serve.swap", duration_s=seconds, ok=ok,
+            rolled_back=rolled_back, version=self.weights_version,
+            step=loaded_step, digest=format_digest(device_digest),
+        )
+        if self.client is not None:
+            self.client.report_event("serve.swap", json.dumps({
+                "ok": ok, "rolled_back": rolled_back,
+                "version": self.weights_version, "step": loaded_step,
+            }))
+        return {
+            "ok": ok, "rolled_back": rolled_back,
+            "version": self.weights_version, "step": loaded_step,
+            "digest": format_digest(device_digest),
+            "seconds": seconds,
+        }
 
     # -- AOT warm-start -------------------------------------------------------
 

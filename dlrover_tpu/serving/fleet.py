@@ -1,0 +1,379 @@
+"""Replica fleet: registry, health, failover and drain over ServingEngines.
+
+The survivability layer between the RPC front door
+(:mod:`dlrover_tpu.serving.frontend`) and the per-replica engines: N
+:class:`~dlrover_tpu.serving.engine.ServingEngine` replicas (sharing one
+set of compiled programs via the process-wide memo — a replica is a slot
+pool + KV cache, not a recompile) behind least-loaded routing, with the
+failure machinery a single engine lacks:
+
+* **registry + health** — replicas are routable while their serve
+  telemetry stays fresh; a replica whose step stamp falls ``stale_after_s``
+  behind the fleet's newest is unroutable until it ticks again.
+* **per-replica CircuitBreaker** (``common/retry.py``) — a replica that
+  keeps failing its step trips open and stops receiving requests; one
+  half-open probe readmits it after the reset window.
+* **death + in-flight resubmission** — the ``replica.death`` Faultline
+  seam fires on every replica's step probe; a fired error IS the scripted
+  crash.  The fleet requeues every request the dead replica had not
+  finished (queued *and* mid-decode, tracked by request id) onto
+  survivors: zero lost.  Greedy requests reproduce identical tokens; a
+  sampled request re-decodes under a survivor's RNG stream — the contract
+  is completion, not bitwise replay.
+* **drain before retire** — scale-in (``ServeScalePolicy`` via
+  :meth:`maybe_scale`) moves a victim's queue to survivors, lets its live
+  slots finish, and only then retires it; requests never die with a
+  planned shrink.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common import faults, telemetry
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.retry import CircuitBreaker
+from dlrover_tpu.serving.engine import Request, RequestResult, ServingEngine
+
+
+class NoReplicaError(RuntimeError):
+    """No routable replica (all dead, tripped or stale)."""
+
+
+class _Replica:
+    __slots__ = ("rid", "engine", "breaker", "last_seen", "draining")
+
+    def __init__(self, rid: str, engine: ServingEngine, breaker: CircuitBreaker,
+                 now: float):
+        self.rid = rid
+        self.engine = engine
+        self.breaker = breaker
+        self.last_seen = now
+        self.draining = False
+
+
+class ReplicaFleet:
+    """Least-loaded router + failover over registered serving replicas."""
+
+    def __init__(
+        self,
+        *,
+        stale_after_s: float = 5.0,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 10.0,
+        min_replicas: int = 1,
+        spawn: Optional[Callable[[], ServingEngine]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._clock = clock
+        self.stale_after_s = stale_after_s
+        self.breaker_threshold = breaker_threshold
+        self.breaker_reset_s = breaker_reset_s
+        self.min_replicas = max(1, min_replicas)
+        # Optional factory for scale-out (in-process replicas share the
+        # compiled-program memo, so spawning is slot-pool cost only).
+        self.spawn = spawn
+        self._replicas: Dict[str, _Replica] = {}
+        self._counter = 0
+        # uid -> rid of the replica currently responsible for it.
+        self._assigned: Dict[str, str] = {}
+        # Original Request per uid, retained until completion — the
+        # resubmission capital: a dead replica's unfinished ids are
+        # re-dispatched from here, not reconstructed from its wreckage.
+        self._requests: Dict[str, Request] = {}
+        self.results: Dict[str, RequestResult] = {}
+        self.cancelled: set = set()
+        # Ledger the drill gates on.
+        self.deaths = 0
+        self.resubmitted = 0
+        self.retired = 0
+
+    # -- registry -------------------------------------------------------------
+
+    def add_replica(
+        self, engine: ServingEngine, rid: Optional[str] = None
+    ) -> str:
+        if rid is None:
+            rid = f"replica-{self._counter}"
+        self._counter += 1
+        self._replicas[rid] = _Replica(
+            rid, engine,
+            CircuitBreaker(
+                failure_threshold=self.breaker_threshold,
+                reset_after_s=self.breaker_reset_s,
+                name=f"serve:{rid}", clock=self._clock,
+            ),
+            self._clock(),
+        )
+        logger.info("fleet: replica %s registered (%d total)",
+                    rid, len(self._replicas))
+        return rid
+
+    def replica_ids(self) -> List[str]:
+        return sorted(self._replicas)
+
+    def _newest_stamp(self) -> float:
+        return max(
+            (r.last_seen for r in self._replicas.values()), default=0.0
+        )
+
+    def routable(self, rid: str) -> bool:
+        replica = self._replicas.get(rid)
+        if replica is None or replica.draining:
+            return False
+        if not replica.breaker.allow():
+            return False
+        # Staleness is relative to the freshest replica, not wall time —
+        # an idle fleet (nobody stepping) keeps everyone routable.
+        return (
+            self._newest_stamp() - replica.last_seen <= self.stale_after_s
+        )
+
+    # -- routing --------------------------------------------------------------
+
+    def _load(self, replica: _Replica) -> int:
+        engine = replica.engine
+        return len(engine._queue) + len(engine._live_slots())
+
+    def submit(self, request: Request) -> str:
+        """Dispatch to the least-loaded routable replica; returns its rid.
+        Raises :class:`NoReplicaError` when nothing is routable and
+        ``ValueError`` (from the engine) for never-admissible requests."""
+        candidates = [
+            r for rid, r in sorted(self._replicas.items())
+            if self.routable(rid)
+        ]
+        if not candidates:
+            raise NoReplicaError(
+                f"no routable replica among {self.replica_ids()}"
+            )
+        replica = min(candidates, key=self._load)
+        replica.engine.submit(request)
+        self._assigned[request.uid] = replica.rid
+        self._requests[request.uid] = request
+        return replica.rid
+
+    # -- the fleet tick -------------------------------------------------------
+
+    def step(self) -> int:
+        """One fleet tick: probe + advance every replica, harvest results.
+        Returns the number of live slots decoded fleet-wide."""
+        decoded = 0
+        for rid in list(self._replicas):
+            replica = self._replicas.get(rid)
+            if replica is None:
+                continue
+            try:
+                # The death probe: a fired error here IS the crash.
+                faults.fire("replica.death", replica=rid)
+                decoded += replica.engine.step()
+            except faults.FaultInjected:
+                self.kill(rid, reason="faultline")
+                continue
+            except Exception as e:  # pragma: no cover - organic step crash
+                logger.exception("replica %s step failed", rid)
+                replica.breaker.record_failure()
+                if not replica.breaker.allow():
+                    self.kill(rid, reason=f"step: {e}")
+                continue
+            replica.breaker.record_success()
+            replica.last_seen = self._clock()
+            self._harvest(replica)
+        return decoded
+
+    def _harvest(self, replica: _Replica):
+        for uid, result in replica.engine.results.items():
+            if uid not in self.results:
+                self.results[uid] = result
+
+    # -- death / failover -----------------------------------------------------
+
+    def unfinished(self, rid: str) -> List[str]:
+        """uids assigned to ``rid`` with no harvested result (queued or
+        mid-decode — both are the dead replica's unpaid debt)."""
+        return [
+            uid for uid, assigned in self._assigned.items()
+            if assigned == rid
+            and uid not in self.results
+            and uid not in self.cancelled
+        ]
+
+    def kill(self, rid: str, reason: str = "killed"):
+        """Remove a replica NOW and resubmit its unfinished requests onto
+        survivors by request id — zero lost."""
+        replica = self._replicas.pop(rid, None)
+        if replica is None:
+            return
+        # Salvage what already finished before the crash landed.
+        self._harvest(replica)
+        debts = self.unfinished(rid)
+        self.deaths += 1
+        logger.warning(
+            "fleet: replica %s dead (%s); resubmitting %d in-flight "
+            "request(s) onto %s",
+            rid, reason, len(debts), self.replica_ids(),
+        )
+        requeued = 0
+        for uid in debts:
+            request = self._requests.get(uid)
+            if request is None:
+                continue
+            try:
+                self.submit(request)
+                requeued += 1
+            except NoReplicaError:
+                # Last replica died: keep the debt booked; the uid stays
+                # unfinished and a later add_replica can pick it up via
+                # resubmit_orphans().
+                logger.error(
+                    "fleet: request %s orphaned (no survivors)", uid
+                )
+        self.resubmitted += requeued
+        telemetry.event(
+            "replica.death", replica=rid, reason=reason,
+            resubmitted=requeued, survivors=len(self._replicas),
+        )
+
+    def resubmit_orphans(self) -> int:
+        """Re-dispatch uids whose replica no longer exists (a total-loss
+        window followed by a fresh replica)."""
+        orphans = [
+            uid for uid, rid in self._assigned.items()
+            if rid not in self._replicas
+            and uid not in self.results
+            and uid not in self.cancelled
+        ]
+        count = 0
+        for uid in orphans:
+            request = self._requests.get(uid)
+            if request is None:
+                continue
+            try:
+                self.submit(request)
+                count += 1
+            except NoReplicaError:
+                break
+        self.resubmitted += count
+        return count
+
+    # -- cancel ---------------------------------------------------------------
+
+    def cancel(self, uid: str) -> bool:
+        """Cancel a still-queued request (True).  A request already
+        holding a slot finishes its decode (False) — mid-flight slots are
+        not torn out from under the compiled step."""
+        if uid in self.results or uid in self.cancelled:
+            return uid in self.cancelled
+        rid = self._assigned.get(uid)
+        replica = self._replicas.get(rid) if rid else None
+        if replica is None:
+            return False
+        queue = replica.engine._queue
+        for entry in list(queue):
+            if entry[0].uid == uid:
+                queue.remove(entry)
+                self.cancelled.add(uid)
+                return True
+        return False
+
+    # -- drain / scale --------------------------------------------------------
+
+    def drain(self, rid: str, max_steps: int = 4096):
+        """Drain one replica: stop admitting, move its queue to survivors,
+        let its live slots finish, then drop it from the registry."""
+        replica = self._replicas.get(rid)
+        if replica is None:
+            return
+        if len(self._replicas) <= self.min_replicas:
+            raise NoReplicaError(
+                f"cannot drain {rid}: fleet at min_replicas="
+                f"{self.min_replicas}"
+            )
+        replica.draining = True
+        # Requeue its waiting requests on the survivors.
+        queue = replica.engine._queue
+        while queue:
+            request, _ = queue.popleft()
+            self.submit(request)
+        # Let live slots run dry — the whole fleet keeps stepping, so the
+        # drain is invisible to every other replica's traffic.
+        for _ in range(max_steps):
+            if not replica.engine._live_slots():
+                break
+            self.step()
+        else:
+            raise RuntimeError(f"drain of {rid} did not converge")
+        self._harvest(replica)
+        self._replicas.pop(rid, None)
+        self.retired += 1
+        logger.info("fleet: replica %s drained and retired", rid)
+
+    def maybe_scale(self, policy) -> Optional[str]:
+        """One ``ServeScalePolicy`` evaluation over the fleet's own
+        aggregate (the in-process analogue of the auto-scaler's
+        ``observe_serving``): hot → spawn a replica (when a ``spawn``
+        factory is wired), comfortably idle → drain-then-retire the
+        least-loaded one.  Returns "out", "in" or None."""
+        stats = self.stats()
+        if stats["replicas"] < 1 or stats["qps"] < policy.min_qps:
+            return None
+        if (
+            stats["p95_s"] > policy.slo_p95_s
+            or stats["occupancy"] > policy.occupancy_high
+        ):
+            if self.spawn is not None:
+                self.add_replica(self.spawn())
+                return "out"
+            return None
+        if (
+            stats["p95_s"] < 0.5 * policy.slo_p95_s
+            and stats["occupancy"] < policy.occupancy_low
+            and len(self._replicas) > self.min_replicas
+        ):
+            victim = min(
+                (r for r in self._replicas.values()),
+                key=self._load,
+            )
+            self.drain(victim.rid)
+            return "in"
+        return None
+
+    # -- stats ----------------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return sum(
+            len(r.engine._queue) for r in self._replicas.values()
+        )
+
+    def pending(self) -> int:
+        """Requests in the system: assigned but not finished/cancelled."""
+        return sum(
+            1 for uid in self._assigned
+            if uid not in self.results and uid not in self.cancelled
+        )
+
+    def service_rate(self) -> float:
+        """Aggregate completion rate (req/s) from the replicas' stats —
+        the denominator of the front door's predicted-wait shed test."""
+        return sum(
+            r.engine.stats()["qps"] for r in self._replicas.values()
+        )
+
+    def stats(self) -> Dict[str, float]:
+        per = [r.engine.stats() for r in self._replicas.values()]
+        n = len(per)
+        return {
+            "replicas": float(n),
+            "qps": sum(s["qps"] for s in per),
+            "p95_s": max((s["p95_s"] for s in per), default=0.0),
+            "occupancy": (
+                sum(s["occupancy"] for s in per) / n if n else 0.0
+            ),
+            "queue_depth": float(self.queue_depth()),
+            "pending": float(self.pending()),
+            "requests": sum(s["requests"] for s in per),
+            "deaths": float(self.deaths),
+            "resubmitted": float(self.resubmitted),
+            "retired": float(self.retired),
+        }

@@ -1,0 +1,122 @@
+"""Live weight hot-swap helpers: checkpoint→decode-params mapping + digest.
+
+The swap contract (``ServingEngine.swap_weights``) is *no drain, no
+recompile*: the three serving programs take params as arguments, so
+replacing the tree with one of identical leaf shapes/dtypes retraces
+nothing — the swap is an assignment between two decode steps.  Everything
+that could break that contract lives here and is checked host-side before
+the engine commits:
+
+* :func:`map_checkpoint_to_params` — match the flat ``{path: array}`` dict
+  a :class:`~dlrover_tpu.checkpoint.engine.StorageStepReader` reassembles
+  (any source world; shard records already crc-verified) onto the serving
+  params tree by keystr path, tolerating the training state's leading
+  ``params`` component.  Any missing leaf or shape/dtype drift refuses the
+  swap up front — a drifted tree means a different model, which needs new
+  programs, not a swap.
+* :func:`host_digest` — numpy replication of ``state_digest``'s fold
+  (uint32 byte-sum per leaf, ``acc = acc*1000003 + leaf_sum`` mod 2^32)
+  over the assembled arrays in serving leaf order: the reference the
+  on-device digest of the swapped tree must reproduce.
+* :func:`flip_param_bit` — the ``serve.swap`` seam's corruption half: one
+  mantissa-bit flip on the already-landed device tree (the programs are
+  untouched), modeling a torn weight push only the digest compare can see.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+#: Leading path components a training checkpoint may wrap params in:
+#: a dict state ``{"params": ...}`` keystrs to ``['params']``, a TrainState
+#: dataclass attribute to ``.params``.
+_PARAMS_PREFIXES = ("['params']", ".params")
+
+
+def leaf_paths(params: Any) -> Tuple[List[Tuple[str, ...]], List[Any]]:
+    """(keystr path tuples, leaves) of the serving params tree, in the
+    same leaf order ``state_digest._digest_tree`` folds them."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    paths = [
+        tuple(jax.tree_util.keystr([k]) for k in path) for path, _ in flat
+    ]
+    return paths, [leaf for _, leaf in flat]
+
+
+def map_checkpoint_to_params(
+    arrays: Dict[Tuple[str, ...], np.ndarray], params: Any
+) -> List[np.ndarray]:
+    """Source array for every serving param leaf, in leaf order.
+
+    Raises ``ValueError`` naming the first unmappable/drifted leaf — the
+    caller must refuse the swap rather than land a partial tree.
+    """
+    by_suffix: Dict[Tuple[str, ...], np.ndarray] = {}
+    for path, arr in arrays.items():
+        by_suffix[path] = arr
+        if len(path) > 1 and path[0] in _PARAMS_PREFIXES:
+            by_suffix.setdefault(path[1:], arr)
+    paths, leaves = leaf_paths(params)
+    out: List[np.ndarray] = []
+    for path, leaf in zip(paths, leaves):
+        src = by_suffix.get(path)
+        if src is None:
+            raise ValueError(
+                f"checkpoint holds no tensor for decode param "
+                f"{''.join(path)} (checkpoint paths: "
+                f"{sorted(''.join(p) for p in arrays)[:8]}...)"
+            )
+        src = np.asarray(src)
+        if tuple(src.shape) != tuple(leaf.shape) or src.dtype != leaf.dtype:
+            raise ValueError(
+                f"decode param {''.join(path)} drifted: checkpoint "
+                f"{src.dtype}{tuple(src.shape)} vs serving "
+                f"{leaf.dtype}{tuple(leaf.shape)} — a different model "
+                "needs new programs, not a hot-swap"
+            )
+        out.append(src)
+    return out
+
+
+def host_digest(arrays: List[np.ndarray]) -> int:
+    """``state_digest``'s fold, replicated on host numpy.
+
+    Per leaf: bitcast to bytes, widen to uint32, sum mod 2^32; fold with
+    ``acc = acc * 1000003 + leaf_sum`` (mod 2^32).  Must stay bitwise
+    equal to ``trainer/state_digest._digest_tree`` — the swapped device
+    tree is digested with *that* program and compared against this.
+    """
+    acc = np.uint64(0)
+    for arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype == np.bool_:
+            arr = arr.astype(np.uint8)
+        leaf_sum = (
+            arr.reshape(-1).view(np.uint8).astype(np.uint32)
+            .sum(dtype=np.uint32)
+        )
+        acc = (acc * np.uint64(1000003) + np.uint64(leaf_sum)) & np.uint64(
+            0xFFFFFFFF
+        )
+    return int(acc)
+
+
+def flip_param_bit(params: Any, *, bit: int = 10) -> Any:
+    """Flip ONE mantissa bit in the first param leaf (device tree in,
+    device tree out) — ``state_digest.flip_mantissa_bit`` for a bare
+    params tree instead of a TrainState."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    leaf = leaves[0]
+    host = np.asarray(jax.device_get(leaf)).copy()
+    flat = host.reshape(-1)
+    if host.dtype.itemsize == 4:
+        flat.view(np.uint32)[0] ^= np.uint32(1) << (bit % 23)
+    elif host.dtype.itemsize == 2:
+        flat.view(np.uint16)[0] ^= np.uint16(1) << (bit % 7)
+    else:
+        flat.view(np.uint8)[0] ^= np.uint8(1) << (bit % 8)
+    leaves[0] = jax.device_put(host, leaf.sharding)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -232,3 +232,44 @@ def test_paral_config_update_and_versioning(master, client):
     updated = client.get_paral_config()
     assert updated.version == base.version + 1
     assert updated.global_batch_size == 64
+
+
+def test_master_kill_restart_agents_rejoin_monotonic_round(tmp_path):
+    """Satellite: kill the master (stop; only state_path survives), start
+    a fresh one from the same state file, and have the agents re-join over
+    the wire — the re-formed world's rendezvous round must be strictly
+    greater than any round the dead master sealed, so agents can tell the
+    re-join from a stale world."""
+    path = str(tmp_path / "master_state.json")
+    first = JobMaster(port=0, num_nodes=2, min_nodes=1, state_path=path)
+    first.start()
+    try:
+        a0 = MasterClient(f"localhost:{first.port}", node_id=0)
+        a1 = MasterClient(f"localhost:{first.port}", node_id=1)
+        a0.join_rendezvous(0, 4)
+        a1.join_rendezvous(1, 4)
+        sealed = a0.get_comm_world(0)
+        assert sealed.round == 1 and sealed.world == {0: 4, 1: 4}
+        first._state_store.save(first)
+        a0.close()
+        a1.close()
+    finally:
+        first.stop()  # the kill: all in-memory state gone
+
+    fresh = JobMaster(port=0, num_nodes=2, min_nodes=1, state_path=path)
+    fresh.start()
+    try:
+        # Restore alone already keeps the counter monotonic...
+        assert fresh.rdzv_managers["elastic-training"]._rdzv_round >= 1
+        a0 = MasterClient(f"localhost:{fresh.port}", node_id=0)
+        a1 = MasterClient(f"localhost:{fresh.port}", node_id=1)
+        a0.join_rendezvous(0, 4)
+        a1.join_rendezvous(1, 4)
+        resealed = a0.get_comm_world(0)
+        # ...and the agents' re-join seals a STRICTLY newer round.
+        assert resealed.world == {0: 4, 1: 4}
+        assert resealed.round > sealed.round
+        a0.close()
+        a1.close()
+    finally:
+        fresh.stop()

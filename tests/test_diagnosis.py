@@ -107,6 +107,32 @@ def test_master_state_roundtrip(tmp_path):
         fresh.stop()
 
 
+def test_state_load_under_storage_read_fault_starts_fresh(tmp_path):
+    """Satellite: MasterStateStore.load speaks the storage.read seam — an
+    injected read error takes the same unreadable-file -> start-fresh path
+    a torn state file would, instead of crashing the restarting master."""
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.master.state_store import MasterStateStore
+
+    path = str(tmp_path / "master_state.json")
+    master = JobMaster(num_nodes=1, min_nodes=1, state_path=path)
+    try:
+        master.speed_monitor.collect_global_step(9, time.time())
+        master._state_store.save(master)
+    finally:
+        master.stop()
+
+    store = MasterStateStore(path)
+    faults.configure("storage.read:error@1", seed=2)
+    try:
+        assert store.load() is None  # injected fault -> start fresh
+        assert ("storage.read", "error", 1) in faults.active().fired
+        state = store.load()  # hit 2 unscripted: the file is fine
+        assert state is not None and state["global_step"] == 9
+    finally:
+        faults.reset()
+
+
 def test_master_restart_without_state_file_is_fresh(tmp_path):
     master = JobMaster(
         num_nodes=1, state_path=str(tmp_path / "none.json")

@@ -147,6 +147,34 @@ def test_relayout_apply_retries_then_succeeds():
     assert ("relayout.apply", "error", 1) in faults.active().fired
 
 
+def test_serving_survivability_seams_are_known_and_plans_parse():
+    """The PR's three serving seams speak the standard grammar: the RPC
+    front door (``serve.rpc``), the hot-swap corruption leg
+    (``serve.swap``) and the fleet's death probe (``replica.death``)."""
+    for seam in ("serve.rpc", "serve.swap", "replica.death"):
+        assert seam in faults.KNOWN_SEAMS
+    rules = faults.parse_plan(
+        "serve.rpc:error@1,4;serve.swap:error@1;"
+        "replica.death:error@every:6"
+    )
+    assert rules[0].kind == "error" and rules[0].hits == {1, 4}
+    assert rules[1].hits == {1}
+    assert rules[2].every == 6
+    assert faults.parse_plan("replica.death:error@p=0.1")[0].prob == 0.1
+
+
+def test_replica_death_seam_fires_at_the_scripted_probe():
+    """A fired error at replica.death IS the crash: deterministic at the
+    scripted hit, booked in the plan's fired ledger with its hit index."""
+    faults.configure("replica.death:error@3", seed=11)
+    for rid in ("replica-0", "replica-1"):
+        faults.fire("replica.death", replica=rid)
+    with pytest.raises(faults.FaultInjected) as ei:
+        faults.fire("replica.death", replica="replica-0")
+    assert ei.value.seam == "replica.death" and ei.value.hit == 3
+    assert ("replica.death", "error", 3) in faults.active().fired
+
+
 @pytest.mark.parametrize("bad", [
     "storage.write",                 # no kind
     "storage.write:explode",         # unknown kind

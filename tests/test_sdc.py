@@ -435,6 +435,51 @@ def test_quarantine_survives_master_restart(tmp_path):
         fresh.stop()
 
 
+def test_serve_and_resize_ledgers_survive_master_restart(tmp_path):
+    """Satellite: the serve ledger (incl. hot-swap counters) and the resize
+    ledger's per-kind seconds split round-trip the state store — a master
+    restart must not read as a counter reset on the ``dlrover_serve_*`` /
+    ``dlrover_resize_seconds_total{kind=...}`` gauges."""
+    path = str(tmp_path / "master_state.json")
+    master = JobMaster(num_nodes=1, min_nodes=1, state_path=path)
+    try:
+        sm = master.speed_monitor
+        sm.record_serve(
+            0, qps=4.0, p95_s=0.25, occupancy=0.5, slots=4.0,
+            requests=12.0, tokens=96.0,
+        )
+        sm.record_swap(0, version=3, ok=True, seconds=0.2)
+        sm.record_swap(0, version=3, ok=False, rolled_back=True, seconds=0.1)
+        sm.record_relayout(0.05, ok=True)
+        sm.begin_resize("preempt", kind="restore")
+        sm.collect_global_step(10, tokens=1)  # closes the open window
+        master._state_store.save(master)
+    finally:
+        master.stop()
+
+    fresh = JobMaster(num_nodes=1, min_nodes=1, state_path=path)
+    try:
+        fresh.start()
+        serve = fresh.speed_monitor.serve_ledger()
+        assert serve["qps"] == 4.0
+        assert serve["p95_s"] == 0.25
+        assert serve["requests"] == 12.0
+        assert serve["swaps"] == 2.0
+        assert serve["swap_rollbacks"] == 1.0
+        assert serve["weights_version"] == 3.0
+        resize = fresh.speed_monitor.resize_ledger()
+        assert resize["resizes"] == 2
+        assert resize["by_reason"]["preempt"] == 1
+        assert resize["by_reason"]["relayout"] == 1
+        assert resize["by_kind"]["relayout"] == pytest.approx(0.05)
+        assert "restore" in resize["by_kind"]
+        # No window survives the restart: the dead master cannot know when
+        # the world re-formed, so only closed totals come back.
+        assert resize["resize_open_s"] == 0.0
+    finally:
+        fresh.stop()
+
+
 # -- trainer: cadence, shipping, and the injected flip ------------------------
 
 

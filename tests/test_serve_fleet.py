@@ -1,0 +1,445 @@
+"""Serving survivability: replica fleet, RPC front door, live hot-swap.
+
+Tier-1 coverage for the serving survivability layer:
+
+1. the fleet — least-loaded routing, per-replica death via the
+   ``replica.death`` seam with zero-lost in-flight resubmission, the
+   total-loss orphan path, drain-before-retire and the
+   ``ServeScalePolicy`` hooks;
+2. the front door — submit/poll/cancel lifecycle over typed messages,
+   bounded admission (``queue_full``), predicted-wait load shedding
+   (fast reject, ``shed``), ``no_fleet``, and the ``serve.rpc`` seam;
+3. live weight hot-swap — record-mapped reshard from a committed
+   checkpoint between decode steps with zero retrace and no slot drain,
+   digest verification bitwise against the ``state_digest`` fold, and
+   rollback when the ``serve.swap`` seam corrupts the landed tree.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import faults
+from dlrover_tpu.master import messages as msg
+from dlrover_tpu.master.auto_scaler import ServeScalePolicy
+from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
+from dlrover_tpu.rl.generation import SamplingParams
+from dlrover_tpu.serving import (
+    NoReplicaError,
+    ReplicaFleet,
+    Request,
+    ServeFrontend,
+    ServingEngine,
+)
+from dlrover_tpu.serving import hotswap
+from dlrover_tpu.trainer import train_lib
+
+VOCAB, SEQ = 64, 32
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Unique shm/job tag + socket dir per test; no fault plan leaks."""
+    monkeypatch.setenv("DLROVER_TPU_JOB", f"sf{os.getpid()}_{tmp_path.name}")
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = TransformerConfig(
+        vocab_size=VOCAB, d_model=32, num_heads=4, num_layers=2,
+        d_ff=64, max_seq_len=SEQ,
+    )
+    params = TransformerLM(config).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return config, params
+
+
+def _engine(setup, slots=2, seed=0):
+    config, params = setup
+    return ServingEngine(config, params, slots=slots, seed=seed)
+
+
+def _req(uid, n=5, new=4):
+    prompt = (np.arange(n, dtype=np.int32) % (VOCAB - 1)) + 1
+    return Request(
+        uid=uid, prompt=prompt, sampling=SamplingParams(max_new_tokens=new)
+    )
+
+
+def _run(fleet, budget=400):
+    for _ in range(budget):
+        if fleet.pending() == 0:
+            return True
+        fleet.step()
+    return fleet.pending() == 0
+
+
+# -- fleet: routing -----------------------------------------------------------
+
+
+def test_least_loaded_routing_spreads_submissions(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    fleet.add_replica(_engine(setup, seed=1))
+    assigned = [fleet.submit(_req(f"r{i}")) for i in range(4)]
+    assert assigned == [
+        "replica-0", "replica-1", "replica-0", "replica-1",
+    ]
+    assert _run(fleet)
+    assert sorted(fleet.results) == ["r0", "r1", "r2", "r3"]
+
+
+def test_unroutable_replicas_are_skipped(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    fleet.add_replica(_engine(setup, seed=1))
+    fleet._replicas["replica-0"].draining = True
+    assert fleet.submit(_req("a")) == "replica-1"
+    fleet._replicas["replica-1"].breaker.record_failure()
+    fleet._replicas["replica-1"].breaker.record_failure()
+    fleet._replicas["replica-1"].breaker.record_failure()
+    with pytest.raises(NoReplicaError):
+        fleet.submit(_req("b"))
+
+
+# -- fleet: death + failover --------------------------------------------------
+
+
+def test_replica_death_resubmits_in_flight_zero_lost(setup):
+    """The tentpole invariant: a replica dying mid-decode loses NOTHING —
+    every unfinished request it held (queued and mid-flight) re-dispatches
+    by id onto survivors and completes."""
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    fleet.add_replica(_engine(setup, seed=1))
+    uids = [f"r{i}" for i in range(6)]
+    for uid in uids:
+        fleet.submit(_req(uid, new=6))
+    # Fires walk the registry in order each step: hit 4 = step 2,
+    # replica-1 — it dies holding live slots AND queued requests.
+    faults.configure("replica.death:error@4", seed=0)
+    assert _run(fleet)
+    assert fleet.deaths == 1
+    assert fleet.resubmitted >= 1
+    assert fleet.replica_ids() == ["replica-0"]
+    assert sorted(fleet.results) == uids  # zero lost
+    assert all(len(fleet.results[u].tokens) > 0 for u in uids)
+
+
+def test_last_replica_death_orphans_then_recovers(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    fleet.submit(_req("a"))
+    fleet.submit(_req("b"))
+    faults.configure("replica.death:error@1", seed=0)
+    fleet.step()  # total loss: no survivors to resubmit onto
+    faults.reset()
+    assert fleet.replica_ids() == [] and fleet.deaths == 1
+    assert fleet.pending() == 2 and not fleet.results
+    # A fresh replica picks the orphans back up — still zero lost.
+    fleet.add_replica(_engine(setup, seed=2))
+    assert fleet.resubmit_orphans() == 2
+    assert _run(fleet)
+    assert sorted(fleet.results) == ["a", "b"]
+
+
+def test_drain_retires_without_loss_and_respects_min_replicas(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    fleet.add_replica(_engine(setup, seed=1))
+    for i in range(6):
+        fleet.submit(_req(f"r{i}", new=5))
+    fleet.step()
+    fleet.drain("replica-0")
+    assert fleet.retired == 1
+    assert fleet.replica_ids() == ["replica-1"]
+    assert _run(fleet)
+    assert len(fleet.results) == 6  # the drained replica's work survived
+    with pytest.raises(NoReplicaError):
+        fleet.drain("replica-1")  # fleet at min_replicas
+
+
+def test_maybe_scale_out_and_in(setup):
+    fleet = ReplicaFleet(spawn=lambda: _engine(setup, seed=9))
+    fleet.add_replica(_engine(setup))
+    policy = ServeScalePolicy(slo_p95_s=1.0, min_qps=0.0)
+    hot = dict(replicas=1.0, qps=5.0, p95_s=2.0, occupancy=0.9)
+    fleet.stats = lambda: hot  # type: ignore[method-assign]
+    assert fleet.maybe_scale(policy) == "out"
+    assert len(fleet._replicas) == 2
+    idle = dict(replicas=2.0, qps=5.0, p95_s=0.1, occupancy=0.05)
+    fleet.stats = lambda: idle  # type: ignore[method-assign]
+    assert fleet.maybe_scale(policy) == "in"
+    assert len(fleet._replicas) == 1 and fleet.retired == 1
+
+
+def test_cancel_hits_only_queued_requests(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup, slots=1))
+    fleet.submit(_req("live"))
+    fleet.submit(_req("queued"))
+    fleet.step()  # "live" takes the only slot; "queued" waits
+    assert fleet.cancel("queued") is True
+    assert fleet.cancel("live") is False  # mid-decode: finishes its slot
+    assert _run(fleet)
+    assert "live" in fleet.results and "queued" not in fleet.results
+
+
+# -- front door ---------------------------------------------------------------
+
+
+def _submit_msg(uid, n=5, new=4, deadline_s=30.0):
+    prompt = tuple(int(t) for t in ((np.arange(n) % (VOCAB - 1)) + 1))
+    return msg.ServeSubmit(
+        uid=uid, prompt=prompt, max_new_tokens=new, deadline_s=deadline_s
+    )
+
+
+def test_frontend_submit_poll_cancel_lifecycle(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    frontend = ServeFrontend(fleet)
+    ticket = frontend.submit(_submit_msg("x", new=4))
+    assert ticket.accepted
+    assert frontend.poll(msg.ServePoll(uid="x")).state == "pending"
+    assert _run(fleet)
+    status = frontend.poll(msg.ServePoll(uid="x"))
+    assert status.state == "done"
+    assert len(status.tokens) == 4 and status.latency_s > 0
+    # Cancel after completion is a no-op: the answer stands.
+    assert frontend.cancel(msg.ServeCancel(uid="x")).state == "done"
+    assert frontend.poll(msg.ServePoll(uid="nope")).state == "unknown"
+
+
+def test_frontend_cancels_queued_request(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup, slots=1))
+    frontend = ServeFrontend(fleet)
+    frontend.submit(_submit_msg("live"))
+    frontend.submit(_submit_msg("queued"))
+    fleet.step()
+    assert frontend.cancel(msg.ServeCancel(uid="queued")).state == "cancelled"
+    assert frontend.poll(msg.ServePoll(uid="queued")).state == "cancelled"
+
+
+def test_frontend_bounded_queue_rejects_fast(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    frontend = ServeFrontend(fleet, max_pending=2)
+    assert frontend.submit(_submit_msg("a")).accepted
+    assert frontend.submit(_submit_msg("b")).accepted
+    ticket = frontend.submit(_submit_msg("c"))
+    assert not ticket.accepted and ticket.reason == "queue_full"
+    assert frontend.poll(msg.ServePoll(uid="c")).state == "queue_full"
+    assert frontend.rejected_full == 1
+
+
+def test_frontend_sheds_when_predicted_wait_exceeds_deadline(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    frontend = ServeFrontend(fleet)
+    # Cold fleet: no measured rate, no evidence to shed on — admit.
+    assert frontend.submit(_submit_msg("warm0")).accepted
+    assert frontend.submit(_submit_msg("warm1")).accepted
+    assert _run(fleet)  # two completions: the engine has a measured qps
+    assert fleet.service_rate() > 0
+    for i in range(4):  # a backlog so predicted wait is non-zero
+        frontend.submit(_submit_msg(f"bk{i}"))
+    t0 = time.perf_counter()
+    ticket = frontend.submit(_submit_msg("tight", deadline_s=1e-9))
+    reject_s = time.perf_counter() - t0
+    assert not ticket.accepted and ticket.reason == "shed"
+    assert ticket.predicted_wait_s > 0
+    assert reject_s < 0.1  # the whole point: an early cheap "no"
+    assert frontend.poll(msg.ServePoll(uid="tight")).state == "shed"
+    assert frontend.shed_count == 1
+    assert _run(fleet)  # the accepted backlog still completes
+
+
+def test_frontend_no_fleet_and_invalid_prompt(setup):
+    frontend = ServeFrontend(ReplicaFleet())
+    ticket = frontend.submit(_submit_msg("a"))
+    assert not ticket.accepted and ticket.reason == "no_fleet"
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    frontend = ServeFrontend(fleet)
+    bad = frontend.submit(_submit_msg("big", n=SEQ + 8))
+    assert not bad.accepted and bad.reason.startswith("invalid")
+
+
+def test_serve_rpc_seam_fails_one_rpc_then_recovers(setup):
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    frontend = ServeFrontend(fleet)
+    faults.configure("serve.rpc:error@1", seed=0)
+    with pytest.raises(faults.FaultInjected):
+        frontend.submit(_submit_msg("a"))  # the caller's RetryPolicy re-issues
+    assert frontend.submit(_submit_msg("a")).accepted  # hit 2: unscripted
+    assert ("serve.rpc", "error", 1) in faults.active().fired
+
+
+def test_front_door_over_the_real_servicer_wire(setup):
+    """The tentpole transport claim: submit/poll/cancel ride the master's
+    existing 2-RPC servicer — typed messages through the restricted
+    unpickler, no new wire surface — and a reported ``serve.swap``
+    telemetry event books into the master's swap ledger and gauges."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.job_master import JobMaster
+
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    master = JobMaster(port=0, num_nodes=1)
+    master.attach_serve_frontend(ServeFrontend(fleet))
+    master.start()
+    try:
+        client = MasterClient(f"localhost:{master.port}", node_id=0)
+        ticket = client.serve_submit(_submit_msg("wire", new=4))
+        assert ticket.accepted
+        assert client.serve_poll("wire").state == "pending"
+        assert _run(fleet)
+        status = client.serve_poll("wire")
+        assert status.state == "done" and len(status.tokens) == 4
+        assert client.serve_cancel("wire").state == "done"
+        # An engine's serve.swap telemetry event lands in the ledger...
+        client.report_telemetry([(
+            "serve.swap", "point", time.time(), 0.25,
+            {"ok": True, "rolled_back": False, "version": 2, "step": 5},
+        )])
+        ledger = master.speed_monitor.serve_ledger()
+        assert ledger["swaps"] == 1.0 and ledger["weights_version"] == 2.0
+        # ...and renders as gauges.
+        metrics = client.get_metrics_text()
+        assert "dlrover_serve_swaps_total 1" in metrics
+        assert "dlrover_serve_weights_version 2" in metrics
+        client.close()
+    finally:
+        master.stop()
+
+
+def test_serve_rpc_without_frontend_is_a_clean_error(setup):
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(port=0, num_nodes=1)
+    master.start()
+    try:
+        client = MasterClient(f"localhost:{master.port}", node_id=0)
+        with pytest.raises(RuntimeError, match="no serving front door"):
+            client.serve_submit(_submit_msg("x"))
+        client.close()
+    finally:
+        master.stop()
+
+
+# -- hot-swap -----------------------------------------------------------------
+
+
+def _save_checkpoint(ckpt_dir, step, params):
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    saver.set_world([0])
+    saver.start()
+    engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    assert engine.save_to_storage(step, {"params": params})
+    assert engine.wait_saver(timeout=60)
+    return engine, saver
+
+
+def test_hotswap_mapping_and_host_digest_parity(setup):
+    """Unit surfaces: the record mapper strips the checkpoint's
+    ``['params']`` prefix and refuses drifted leaves; the host digest is
+    bitwise the jitted ``state_digest`` fold."""
+    from dlrover_tpu.trainer.state_digest import _digest_tree
+
+    config, params = setup
+    paths, leaves = hotswap.leaf_paths(params)
+    arrays = {
+        ("['params']",) + p: np.asarray(leaf)
+        for p, leaf in zip(paths, leaves)
+    }
+    sources = hotswap.map_checkpoint_to_params(arrays, params)
+    for src, leaf in zip(sources, leaves):
+        np.testing.assert_array_equal(src, np.asarray(leaf))
+    assert hotswap.host_digest(sources) == int(
+        np.asarray(jax.jit(_digest_tree)(params))
+    )
+    missing = dict(arrays)
+    missing.pop(next(iter(missing)))
+    with pytest.raises(ValueError, match="no tensor"):
+        hotswap.map_checkpoint_to_params(missing, params)
+    drifted = {
+        p: (a.reshape(-1, 1) if a.ndim == 2 else a)
+        for p, a in arrays.items()
+    }
+    with pytest.raises(ValueError):
+        hotswap.map_checkpoint_to_params(drifted, params)
+
+
+def test_swap_weights_live_zero_retrace_then_rollback(setup, tmp_path):
+    """The tentpole swap contract, both legs on one checkpoint: a clean
+    swap lands between decode steps with zero retrace and no slot drain;
+    a ``serve.swap``-corrupted swap is caught by the digest compare and
+    rolls back to the serving tree."""
+    config, params = setup
+    swapped_params = jax.tree.map(lambda x: x * 1.5, params)
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_engine, saver = _save_checkpoint(ckpt_dir, 5, swapped_params)
+    try:
+        engine = ServingEngine(config, params, slots=2, seed=0)
+        engine.submit(_req("a", new=6))
+        engine.step()
+        live_before = len(engine._live_slots())
+        assert live_before == 1
+        counts = {
+            k: train_lib.TRACE_COUNTS[k]
+            for k in ("serve_prefill", "serve_insert", "serve_decode")
+        }
+        report = engine.swap_weights(ckpt_dir)
+        assert report["ok"] and not report["rolled_back"]
+        assert report["step"] == 5 and report["version"] == 1
+        assert engine.weights_version == 1
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(engine.params)[0]),
+            np.asarray(jax.tree.leaves(swapped_params)[0]),
+        )
+        # No drain: the live slot kept its KV row through the swap...
+        assert len(engine._live_slots()) == live_before
+        engine.step()  # ...and keeps decoding under the new weights
+        for name, before in counts.items():
+            assert train_lib.TRACE_COUNTS[name] == before  # zero retrace
+
+        # Corrupted leg: the seam flips a landed mantissa bit.
+        faults.configure("serve.swap:error@1", seed=0)
+        report2 = engine.swap_weights(ckpt_dir)
+        faults.reset()
+        assert not report2["ok"] and report2["rolled_back"]
+        assert report2["version"] == 1  # version pinned to the good tree
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(engine.params)[0]),
+            np.asarray(jax.tree.leaves(swapped_params)[0]),
+        )
+        results = engine.drain()
+        assert "a" in results  # service never stopped
+    finally:
+        ckpt_engine._shm.close(unlink=True)
+        saver.stop()
+
+
+def test_swap_weights_without_committed_step_raises(setup, tmp_path):
+    config, params = setup
+    engine = ServingEngine(config, params, slots=2, seed=0)
+    with pytest.raises(RuntimeError, match="no verifiable"):
+        engine.swap_weights(str(tmp_path / "empty"))

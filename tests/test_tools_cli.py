@@ -72,6 +72,11 @@ def test_serve_bench_help(cpu_child_env):
     assert out.returncode == 0, out.stderr
     assert "--slots" in out.stdout and "--out" in out.stdout
     assert "--buckets" in out.stdout and "--requests" in out.stdout
+    # The serving front-door drill rides the same tool.
+    assert "--fleet-drill" in out.stdout
+    assert "--replicas" in out.stdout and "--max-pending" in out.stdout
+    assert "--deadline-s" in out.stdout and "--slo-p95-s" in out.stdout
+    assert "--kill-tick" in out.stdout and "--shed-budget-s" in out.stdout
 
 
 def test_tracelint_json_smoke(tmp_path, cpu_child_env):
@@ -154,6 +159,54 @@ def test_serve_bench_gate_predicate():
     ok, failed = tool.evaluate_gate(continuous, short, 8, ledger)
     assert not ok
     assert "static_completed" in failed and "token_parity" in failed
+
+
+def test_serve_fleet_gate_predicate():
+    """The --fleet-drill ok gate is a pure predicate over the drill dict:
+    every survivability invariant is a named check."""
+    tool = _load_module(
+        os.path.join(REPO, "tools", "serve_bench.py"), "_serve_bench"
+    )
+    drill = {
+        "submitted": 24, "accepted": 24, "deaths": 1, "resubmitted": 12,
+        "lost": 0, "recovered": True, "post_death_completions": 20,
+        "p95_post_death_s": 0.4, "slo_p95_s": 1.0,
+        "shed": {
+            "rejected": True, "reject_s": 0.001, "budget_s": 0.1,
+            "cancelled": True, "drained": True,
+        },
+        "swap": {
+            "ok": True, "version": 1, "retraces": 0, "no_drain": True,
+        },
+        "swap_corrupt": {
+            "ok": False, "rolled_back": True, "version": 1,
+            "served_after": True,
+        },
+    }
+    ok, failed = tool.evaluate_fleet_gate(drill)
+    assert ok and failed == []
+
+    lossy = dict(drill, lost=2)
+    ok, failed = tool.evaluate_fleet_gate(lossy)
+    assert not ok and failed == ["zero_lost"]
+
+    slow_shed = dict(drill, shed=dict(drill["shed"], reject_s=0.5))
+    ok, failed = tool.evaluate_fleet_gate(slow_shed)
+    assert not ok and failed == ["shed_fast"]
+
+    retraced = dict(drill, swap=dict(drill["swap"], retraces=3))
+    ok, failed = tool.evaluate_fleet_gate(retraced)
+    assert not ok and failed == ["swap_zero_retrace"]
+
+    no_rollback = dict(
+        drill, swap_corrupt=dict(drill["swap_corrupt"], rolled_back=False)
+    )
+    ok, failed = tool.evaluate_fleet_gate(no_rollback)
+    assert not ok and failed == ["rollback_on_corruption"]
+
+    breached = dict(drill, p95_post_death_s=2.0)
+    ok, failed = tool.evaluate_fleet_gate(breached)
+    assert not ok and failed == ["p95_recovered_under_slo"]
 
 
 def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):

@@ -687,18 +687,56 @@ def test_seam001_unregistered_seam_does_not_count(tmp_path):
     assert rule_ids(report) == ["SEAM001"]
 
 
-def test_seam001_ignores_cold_tiers_and_reads(tmp_path):
+def test_seam001_ignores_cold_tiers(tmp_path):
     report = lint(tmp_path, "serving.py", SEAM001_BAD, select=["SEAM001"])
     assert report.findings == []
-    (tmp_path / "data").mkdir()
-    read_only = """\
+
+
+SEAM001_READ_BAD = """\
 def load(path):
     with open(path) as fh:
         return fh.read()
 """
+
+SEAM001_READ_OK = """\
+from dlrover_tpu.common import faults
+
+def load(path):
+    faults.fire("storage.read", path=path)
+    with open(path) as fh:
+        return fh.read()
+"""
+
+
+def test_seam001_flags_uncovered_reads_in_fault_tiers(tmp_path):
+    """A read that silently swallows I/O errors is exactly the path a
+    storage drill needs to reach — uncovered ``open``-for-read in a fault
+    tier fires, and a ``storage.read`` seam covers it."""
+    (tmp_path / "data").mkdir()
     report = lint(
         tmp_path, os.path.join("data", "m.py"),
-        read_only, select=["SEAM001"],
+        SEAM001_READ_BAD, select=["SEAM001"],
+    )
+    assert rule_ids(report) == ["SEAM001"]
+    assert {f.symbol for f in report.findings} == {"load:open-for-read"}
+    report = lint(
+        tmp_path, os.path.join("data", "ok.py"),
+        SEAM001_READ_OK, select=["SEAM001"],
+    )
+    assert report.findings == []
+
+
+def test_seam001_proc_reads_are_exempt(tmp_path):
+    """/proc pseudo-files are kernel state, not storage: no seam owed."""
+    (tmp_path / "agent").mkdir()
+    proc_only = """\
+def cpu_times():
+    with open("/proc/stat") as fh:
+        return fh.read()
+"""
+    report = lint(
+        tmp_path, os.path.join("agent", "m.py"),
+        proc_only, select=["SEAM001"],
     )
     assert report.findings == []
 

@@ -18,6 +18,18 @@ the ledger the ``ok`` gate checks.
 
     python tools/serve_bench.py --slots 4 --requests 24 --out SERVE.json
 
+``--fleet-drill`` runs the serving *survivability* drill instead (writes
+SERVE_FLEET.json): the same trace goes through the RPC front door
+(``ServeFrontend``) onto a ``ReplicaFleet``, then the drill kills a
+replica mid-flight via the ``replica.death`` Faultline seam (zero lost
+requests — every in-flight id resubmits onto survivors), measures a load
+shed's fast-reject wall time against its budget, cancels a queued
+request, hot-swaps the survivors' weights from a checkpoint between
+decode steps (zero retrace, no slot drain) and finishes with a
+scripted-corruption swap that must roll back and keep serving.
+
+    python tools/serve_bench.py --fleet-drill --replicas 2
+
 Runs on CPU (JAX_PLATFORMS=cpu) by default: the comparison is about
 scheduling, not the chip — both legs run the same compiled programs.
 """
@@ -137,6 +149,276 @@ def evaluate_gate(continuous, static, n_requests, ledger):
     return not failed, failed
 
 
+def evaluate_fleet_gate(drill):
+    """The ``--fleet-drill`` ok gate as a pure predicate (testable from
+    ``test_tools_cli`` without running the drill): zero lost requests
+    across the replica death, sub-budget shed reject, bounded recovery
+    with post-death p95 back under the SLO, and a hot-swap that neither
+    retraces nor drains — with the corrupted leg rolled back and still
+    serving."""
+    checks = {
+        "all_accepted": drill["accepted"] == drill["submitted"],
+        "death_fired": drill["deaths"] >= 1,
+        "resubmitted": drill["resubmitted"] >= 1,
+        "zero_lost": drill["lost"] == 0,
+        "recovered_in_budget": drill["recovered"],
+        "post_death_completions": drill["post_death_completions"] >= 1,
+        "p95_recovered_under_slo":
+            drill["p95_post_death_s"] <= drill["slo_p95_s"],
+        "shed_rejected": drill["shed"]["rejected"],
+        "shed_fast": drill["shed"]["reject_s"] < drill["shed"]["budget_s"],
+        "cancel_honored": drill["shed"]["cancelled"],
+        "backlog_drained": drill["shed"]["drained"],
+        "swap_ok": drill["swap"]["ok"],
+        "swap_zero_retrace": drill["swap"]["retraces"] == 0,
+        "swap_no_drain": drill["swap"]["no_drain"],
+        "rollback_on_corruption": (
+            drill["swap_corrupt"]["rolled_back"]
+            and not drill["swap_corrupt"]["ok"]
+        ),
+        "version_pinned_after_rollback":
+            drill["swap_corrupt"]["version"] == drill["swap"]["version"],
+        "serving_after_rollback": drill["swap_corrupt"]["served_after"],
+    }
+    failed = sorted(name for name, held in checks.items() if not held)
+    return not failed, failed
+
+
+def _quantile(values, p):
+    values = sorted(values)
+    if not values:
+        return 0.0
+    return values[min(len(values) - 1, int(p * len(values)))]
+
+
+def run_fleet_drill(args, out_path: str) -> int:
+    import shutil
+    import tempfile
+
+    # Isolate the checkpoint shm/socket namespace like the test suite does.
+    os.environ.setdefault("DLROVER_TPU_JOB", f"servefleet{os.getpid()}")
+    os.environ.setdefault("DLROVER_TPU_SOCKET_DIR", tempfile.mkdtemp())
+
+    import jax
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+    from dlrover_tpu.common import faults
+    from dlrover_tpu.master import messages as msg
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+    from dlrover_tpu.serving import ReplicaFleet, ServeFrontend, ServingEngine
+    from dlrover_tpu.trainer import train_lib
+
+    config, params = build_model(args)
+    trace = make_trace(args)
+    buckets = tuple(int(w) for w in args.buckets.split(","))
+
+    # The hot-swap payload: a recognizably different param tree on disk,
+    # saved through the real checkpoint path so the digest chain (crc
+    # sidecars + shard crcs) is the one production restores verify.
+    swap_step = 7
+    ckpt_dir = tempfile.mkdtemp(prefix="serve_fleet_ckpt_")
+    swapped_params = jax.tree.map(lambda x: x * 1.25, params)
+    saver = AsyncCheckpointSaver(ckpt_dir, host_index=0, num_hosts=1)
+    saver.set_world([0])
+    saver.start()
+    ckpt_engine = CheckpointEngine(
+        ckpt_dir, host_index=0, num_hosts=1, agree_step_fn=lambda c: c
+    )
+    try:
+        if not ckpt_engine.save_to_storage(
+            swap_step, {"params": swapped_params}
+        ) or not ckpt_engine.wait_saver(timeout=120):
+            print("fleet drill: checkpoint save failed", file=sys.stderr)
+            return 1
+
+        fleet = ReplicaFleet(min_replicas=1)
+        for i in range(args.replicas):
+            fleet.add_replica(ServingEngine(
+                config, params, slots=args.slots, buckets=buckets,
+                seed=args.seed + i,
+            ))
+        frontend = ServeFrontend(
+            fleet, max_pending=args.max_pending,
+            default_deadline_s=args.deadline_s,
+        )
+
+        def submit(uid, prompt, sampling, deadline_s):
+            return frontend.submit(msg.ServeSubmit(
+                uid=uid, prompt=tuple(int(t) for t in prompt),
+                max_new_tokens=sampling.max_new_tokens,
+                temperature=sampling.temperature, top_k=sampling.top_k,
+                deadline_s=deadline_s,
+            ))
+
+        # -- phase 1: failover. Kill the last replica on tick --kill-tick
+        # (the seam fires once per replica per fleet step, registry
+        # order), mid-flight, and require every accepted request to
+        # complete anyway.
+        tickets = [
+            submit(uid, prompt, sampling, args.deadline_s)
+            for uid, prompt, sampling in trace
+        ]
+        accepted = [t.uid for t in tickets if t.accepted]
+        death_hit = (args.kill_tick - 1) * args.replicas + args.replicas
+        faults.configure(f"replica.death:error@{death_hit}", seed=args.seed)
+        deaths_before = fleet.deaths
+        post_death_uids = set()
+        death_wall = None
+        steps = 0
+        while fleet.pending() > 0 and steps < args.recover_steps:
+            done_before = set(fleet.results)
+            fleet.step()
+            steps += 1
+            if fleet.deaths > deaths_before and death_wall is None:
+                death_wall = time.perf_counter()
+            if death_wall is not None:
+                post_death_uids |= set(fleet.results) - done_before
+        faults.reset()
+        recovered = fleet.pending() == 0
+        recover_wall_s = (
+            time.perf_counter() - death_wall if death_wall else 0.0
+        )
+        done = [
+            uid for uid in accepted
+            if frontend.poll(msg.ServePoll(uid=uid)).state == "done"
+        ]
+        lost = sorted(set(accepted) - set(done))
+        post_lat = [fleet.results[u].latency_s for u in post_death_uids]
+        p95_post = _quantile(post_lat, 0.95)
+
+        # -- phase 2: backpressure. With a measured service rate and a
+        # backlog, a tiny-deadline submit must fast-reject as a shed; a
+        # queued request must be cancellable; the backlog must drain.
+        backlog = []
+        for i in range(3 * args.slots):
+            uid, prompt, sampling = trace[i % len(trace)]
+            backlog.append(f"bk{i:03d}")
+            submit(backlog[-1], prompt, sampling, args.deadline_s)
+        t0 = time.perf_counter()
+        shed_ticket = submit("shedprobe", trace[0][1], trace[0][2], 1e-6)
+        shed_reject_s = time.perf_counter() - t0
+        cancel_status = frontend.cancel(msg.ServeCancel(uid=backlog[-1]))
+        for _ in range(args.recover_steps):
+            if fleet.pending() == 0:
+                break
+            fleet.step()
+        drained = fleet.pending() == 0
+
+        # -- phase 3: live hot-swap between decode steps. Two requests
+        # hold live slots; the swap must neither retrace the three decode
+        # programs nor free a slot.
+        for i, uid in enumerate(("swap-a", "swap-b")):
+            submit(uid, trace[i][1], trace[i][2], args.deadline_s)
+        fleet.step()
+        live_before = sum(
+            len(r.engine._live_slots()) for r in fleet._replicas.values()
+        )
+        trace_keys = ("serve_prefill", "serve_insert", "serve_decode")
+        counts_before = {k: train_lib.TRACE_COUNTS[k] for k in trace_keys}
+        reports = [
+            r.engine.swap_weights(ckpt_dir)
+            for r in fleet._replicas.values()
+        ]
+        retraces = sum(
+            train_lib.TRACE_COUNTS[k] - counts_before[k] for k in trace_keys
+        )
+        live_after = sum(
+            len(r.engine._live_slots()) for r in fleet._replicas.values()
+        )
+        swap = {
+            "ok": all(r["ok"] and not r["rolled_back"] for r in reports),
+            "version": max((r["version"] for r in reports), default=0),
+            "step": max((r["step"] for r in reports), default=-1),
+            "seconds": round(sum(r["seconds"] for r in reports), 4),
+            "retraces": int(retraces),
+            "no_drain": live_before > 0 and live_after == live_before,
+            "live_slots": live_before,
+            "replicas_swapped": len(reports),
+        }
+
+        # -- phase 4: corrupted swap. The serve.swap seam flips one
+        # mantissa bit after landing; the digest check must catch it,
+        # roll back to the phase-3 weights, and keep serving.
+        faults.configure("serve.swap:error@1", seed=args.seed)
+        survivor = next(iter(fleet._replicas.values())).engine
+        corrupt_report = survivor.swap_weights(ckpt_dir)
+        faults.reset()
+        submit("post-rollback", trace[0][1], trace[0][2], args.deadline_s)
+        for _ in range(args.recover_steps):
+            if fleet.pending() == 0:
+                break
+            fleet.step()
+        served_after = (
+            frontend.poll(msg.ServePoll(uid="post-rollback")).state == "done"
+        )
+        swap_corrupt = {
+            "ok": bool(corrupt_report["ok"]),
+            "rolled_back": bool(corrupt_report["rolled_back"]),
+            "version": int(corrupt_report["version"]),
+            "served_after": served_after,
+        }
+
+        # Book the drill into a master-side ledger exactly as the
+        # servicer would, so the artifact carries the gauge view too.
+        sm = SpeedMonitor()
+        for i, rep in enumerate(reports + [corrupt_report]):
+            sm.record_swap(
+                i, version=rep["version"], ok=rep["ok"],
+                rolled_back=rep["rolled_back"], seconds=rep["seconds"],
+            )
+        for i, replica in enumerate(fleet._replicas.values()):
+            sm.record_serve(i, **replica.engine.stats())
+
+        drill = {
+            "submitted": len(tickets),
+            "accepted": len(accepted),
+            "deaths": fleet.deaths,
+            "resubmitted": fleet.resubmitted,
+            "lost": len(lost),
+            "lost_uids": lost,
+            "recovered": recovered,
+            "recover_steps": steps,
+            "recover_wall_s": round(recover_wall_s, 4),
+            "post_death_completions": len(post_lat),
+            "p95_post_death_s": round(p95_post, 5),
+            "slo_p95_s": args.slo_p95_s,
+            "shed": {
+                "rejected": (
+                    not shed_ticket.accepted
+                    and shed_ticket.reason == "shed"
+                ),
+                "reason": shed_ticket.reason,
+                "predicted_wait_s": round(
+                    shed_ticket.predicted_wait_s, 5
+                ),
+                "reject_s": round(shed_reject_s, 5),
+                "budget_s": args.shed_budget_s,
+                "cancelled": cancel_status.state == "cancelled",
+                "drained": drained,
+            },
+            "swap": swap,
+            "swap_corrupt": swap_corrupt,
+            "serve_ledger": sm.serve_ledger(),
+        }
+        ok, failed_checks = evaluate_fleet_gate(drill)
+        result = {
+            "metric": "requests lost to a mid-flight replica death",
+            "value": len(lost),
+            "unit": "requests",
+            "detail": {"ok": ok, "failed_checks": failed_checks, **drill},
+        }
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        return 0 if ok else 1
+    finally:
+        faults.reset()
+        ckpt_engine._shm.close(unlink=True)
+        saver.stop()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="continuous- vs static-batching serving bench "
@@ -157,10 +439,38 @@ def main() -> int:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--max-seq-len", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", default="SERVE.json")
+    ap.add_argument("--out", default="",
+                    help="artifact path (default SERVE.json, or "
+                         "SERVE_FLEET.json under --fleet-drill)")
+    drill = ap.add_argument_group("fleet drill (serving front door)")
+    drill.add_argument("--fleet-drill", action="store_true",
+                       help="run the survivability drill instead: RPC "
+                            "front door + replica death failover + load "
+                            "shed + live weight hot-swap w/ rollback "
+                            "(writes SERVE_FLEET.json)")
+    drill.add_argument("--replicas", type=int, default=2,
+                       help="serving replicas behind the front door")
+    drill.add_argument("--max-pending", type=int, default=64,
+                       help="front-door bounded admission queue size")
+    drill.add_argument("--deadline-s", type=float, default=30.0,
+                       help="per-request deadline the shed test uses")
+    drill.add_argument("--slo-p95-s", type=float, default=30.0,
+                       help="post-death p95 latency must recover under "
+                            "this SLO")
+    drill.add_argument("--kill-tick", type=int, default=3,
+                       help="fleet step on which the replica.death seam "
+                            "kills the last replica")
+    drill.add_argument("--recover-steps", type=int, default=512,
+                       help="bounded recovery window (fleet steps)")
+    drill.add_argument("--shed-budget-s", type=float, default=0.1,
+                       help="a shed reject slower than this fails the "
+                            "gate")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.fleet_drill:
+        return run_fleet_drill(args, args.out or "SERVE_FLEET.json")
+    args.out = args.out or "SERVE.json"
     from dlrover_tpu.master.speed_monitor import SpeedMonitor
 
     config, params = build_model(args)

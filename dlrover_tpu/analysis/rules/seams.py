@@ -42,7 +42,7 @@ FALLBACK_SEAMS: Tuple[str, ...] = (
     "rpc.report", "rpc.get", "storage.write", "storage.read",
     "saver.persist", "saver.flush", "backend.init", "coworker.fetch",
     "preempt.notice", "rdzv.join", "sdc.flip", "serve.admit",
-    "serve.rpc", "serve.swap", "replica.death",
+    "serve.rpc", "serve.swap", "replica.death", "http.serve",
 )
 
 #: Dotted call names that are raw I/O regardless of arguments.

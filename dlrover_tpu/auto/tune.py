@@ -114,6 +114,9 @@ class Candidate:
     # rather than as an additive term — exposed so the candidate table
     # shows when a shape is input-bound (t_h2d is the max).
     est_h2d_time: float = 0.0
+    # Collective (ICI/DCN) traffic time — the component the calibration
+    # ledger corrects separately from compute (apply_calibration).
+    est_comm_time: float = 0.0
     measured_step_time: Optional[float] = None
     measured_tokens_per_sec: Optional[float] = None
     rejected: str = ""
@@ -468,6 +471,7 @@ def _estimate(
     cand.est_recompute_time = t_recompute
     cand.est_dma_time = t_dma
     cand.est_h2d_time = t_h2d
+    cand.est_comm_time = t_ici * bubble
     cand.est_step_time = (
         max(t_compute, t_hbm, t_h2d) + t_recompute + t_dma + t_ici
     ) * bubble
@@ -758,6 +762,34 @@ def _broadcast_choice(best: Candidate, ranked: List[Candidate]) -> Candidate:
     return Candidate(parallel, remat, **knobs)
 
 
+def apply_calibration(candidates, ledger):
+    """Measurement-correct ``est_*`` in place before ranking.
+
+    ``ledger`` is a :class:`dlrover_tpu.master.calibration.CalibrationLedger`
+    (or None — no-op): its aggregate ``ratios()`` carry the EWMA of
+    measured/modeled device seconds per phase kind from profiler capture
+    windows.  The estimator's collective component (``est_comm_time``)
+    scales by the collective ratio and everything else by the compute
+    ratio, so a cost model that (say) under-prices DCN traffic 2x stops
+    ranking communication-heavy layouts above what the hardware actually
+    runs faster.  Rejected candidates keep their sentinel estimates.
+    """
+    if ledger is None:
+        return
+    ratios = ledger.ratios()
+    if not ratios:
+        return
+    r_compute = float(ratios.get("compute", 1.0))
+    r_collective = float(ratios.get("collective", 1.0))
+    for cand in candidates:
+        if cand.rejected or not math.isfinite(cand.est_step_time):
+            continue
+        comm = min(cand.est_comm_time, cand.est_step_time)
+        base = cand.est_step_time - comm
+        cand.est_step_time = base * r_compute + comm * r_collective
+        cand.est_comm_time = comm * r_collective
+
+
 def auto_tune(
     config: TransformerConfig,
     *,
@@ -772,6 +804,7 @@ def auto_tune(
     search_batch: bool = False,
     search_kernels: bool = False,
     max_enumerate: int = 32768,
+    calibration=None,
 ) -> TuneResult:
     """Find the best (ParallelConfig, remat) for ``config`` on this mesh.
 
@@ -831,6 +864,8 @@ def auto_tune(
             cand.global_batch_size or global_batch_size,
             seq_len, optimizer, n_devices,
         )
+    apply_calibration(candidates, calibration)
+
     def est_rank(c: Candidate) -> float:
         if not search_batch:
             return c.est_step_time
@@ -864,6 +899,7 @@ def auto_tune(
                 cand.global_batch_size or global_batch_size,
                 seq_len, optimizer, n_devices,
             )
+        apply_calibration(fresh, calibration)
         feasible = sorted(
             feasible + [c for c in fresh if not c.rejected], key=est_rank
         )

@@ -34,7 +34,7 @@ Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
 ``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``,
 ``tpu.api``, ``relayout.apply``, ``serve.rpc``, ``serve.swap``,
-``replica.death``.
+``replica.death``, ``http.serve``.
 """
 
 from __future__ import annotations
@@ -102,6 +102,11 @@ KNOWN_SEAMS = (
     # fired error IS the scripted replica crash — the fleet must requeue
     # that replica's in-flight requests onto survivors with zero lost.
     "replica.death",
+    # HTTP observability plane seam (master/http_plane.py): fires on the
+    # scrape server's bind and on every GET — an error kind answers the
+    # scraper 503 exactly like a wedged master, delay kinds model slow
+    # scrapes holding handler threads.
+    "http.serve",
 )
 
 

@@ -292,6 +292,80 @@ class SDCVoteOperator(InferenceOperator):
         return out
 
 
+class StepRegressionOperator(InferenceOperator):
+    """Performance-regression sentinel over the job's step-time series.
+
+    Freezes a p50 baseline from the first ``MIN_STEPS`` steps of a
+    *program generation* — the generation key is (compile events,
+    resizes), so any recompile or elastic resize starts a fresh baseline
+    instead of tripping the alarm (a resize legitimately changes the step
+    time; that's a re-layout, not a regression).  Within a stable
+    generation, a recent p50 drifting more than ``DRIFT`` above the
+    baseline is the machine-got-slower signature (thermal throttling, a
+    sick interconnect, noisy neighbor) and surfaces ONE latched REPORT,
+    counted on ``dlrover_perf_regressions_total``.
+    """
+
+    name = "step_regression"
+    MIN_STEPS = 8          # steps to freeze the baseline / judge recency
+    DRIFT = 1.15           # recent p50 > 1.15x baseline p50 fires
+
+    def __init__(self):
+        self._generation = None
+        self._baseline: Optional[float] = None
+        self._pending: List[float] = []
+        self._fired = False
+
+    @staticmethod
+    def _p50(values: List[float]) -> float:
+        ordered = sorted(values)
+        return ordered[len(ordered) // 2]
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        if ctx.timeline is None:
+            return []
+        sm = ctx.speed_monitor
+        compile_events = getattr(
+            sm, "compile_ledger", lambda: {}
+        )().get("compile_events", 0)
+        resizes = getattr(
+            sm, "resize_ledger", lambda: {}
+        )().get("resizes", 0)
+        generation = (compile_events, resizes)
+        if generation != self._generation:
+            # New program generation: everything seen so far priced a
+            # different program/world — reset and relearn.
+            self._generation = generation
+            self._baseline = None
+            self._pending = []
+            self._fired = False
+        series = ctx.timeline.step_time_series()
+        if self._baseline is None:
+            # Freeze the baseline from the generation's FIRST window.
+            self._pending = [d for _, d in series[-self.MIN_STEPS:]]
+            if len(self._pending) >= self.MIN_STEPS:
+                self._baseline = self._p50(self._pending)
+            return []
+        if self._fired or len(series) < 2 * self.MIN_STEPS:
+            return []
+        recent = self._p50([d for _, d in series[-self.MIN_STEPS:]])
+        if self._baseline <= 0 or recent <= self.DRIFT * self._baseline:
+            return []
+        self._fired = True  # one report per generation, not per tick
+        if hasattr(ctx.timeline, "bump"):
+            ctx.timeline.bump("perf_regressions")
+        return [DiagnosisAction(
+            ActionType.REPORT,
+            reason=(
+                f"step time regressed: recent p50 {recent:.4f}s vs "
+                f"baseline {self._baseline:.4f}s "
+                f"(+{(recent / self._baseline - 1) * 100:.0f}%) with no "
+                "compile or resize in the window"
+            ),
+            severity=1,
+        )]
+
+
 class InferenceChain:
     """Run the operators, combine evidence, rank the produced actions.
 
@@ -309,6 +383,7 @@ class InferenceChain:
             StragglerOperator(),
             NumericAnomalyOperator(),
             SDCVoteOperator(),
+            StepRegressionOperator(),
         ]
 
     def infer(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:

@@ -51,10 +51,13 @@ class JobMaster:
         state_path: str = "",
         brain_overrides: Optional[Dict[str, float]] = None,
         pools: Optional[Dict[str, int]] = None,
+        metrics_port: int = 0,
     ):
+        from dlrover_tpu.master.calibration import CalibrationLedger
         from dlrover_tpu.master.timeline import JobTimeline
 
         self.speed_monitor = SpeedMonitor()
+        self.calibration = CalibrationLedger()
         self.task_manager = TaskManager()
         self.kv_store = KVStore()
         self.metrics = MetricsCollector()
@@ -142,20 +145,48 @@ class JobMaster:
             metrics=self.metrics,
             timeline=self.timeline,
             auto_scaler=self.auto_scaler,
+            calibration=self.calibration,
         )
         self._server = None
         self.port = port
+        # Live scrape surface (master/http_plane.py); 0 = off.
+        self.metrics_port = metrics_port
+        self.http_plane = None
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
     def attach_serve_frontend(self, frontend):
         """Wire a serving front door (serving/frontend.py) into the
         servicer: ServeSubmit/ServePoll/ServeCancel become live RPCs on
-        the master's existing 2-RPC transport."""
+        the master's existing 2-RPC transport.  The fleet's retire hook
+        closes the eviction gap: a drained/killed replica drops its
+        timeline + serve-ledger series exactly like a retired node does."""
         self.servicer.serve_frontend = frontend
+        fleet = getattr(frontend, "fleet", None)
+        if fleet is not None and getattr(fleet, "retire_hook", None) is None:
+            fleet.retire_hook = self._handle_replica_retired
+
+    def _handle_replica_retired(self, rid: str):
+        """A serving replica left the fleet (drain on scale-in, or death):
+        evict its observability series so a retired replica's stale step
+        spans and serve stats stop polluting the aggregates — the same
+        contract node retirement has."""
+        digits = "".join(ch for ch in str(rid) if ch.isdigit())
+        if not digits:
+            return
+        node_id = int(digits)
+        self.timeline.evict_node(node_id)
+        self.speed_monitor.evict_serve(node_id)
 
     def prepare(self):
         self._server, self.port = start_master_server(self.servicer, self.port)
+        if self.metrics_port > 0 and self.http_plane is None:
+            from dlrover_tpu.master.http_plane import MetricsHTTPServer
+
+            self.http_plane = MetricsHTTPServer(
+                self.servicer, port=self.metrics_port
+            )
+            self.metrics_port = self.http_plane.start()
 
     def start(self):
         # Restore BEFORE the gRPC server opens: a reconnecting agent racing
@@ -381,6 +412,9 @@ class JobMaster:
         self._stop.set()
         if self._loop_thread:
             self._loop_thread.join(timeout=5)
+        if self.http_plane is not None:
+            self.http_plane.stop()
+            self.http_plane = None
         if self._server:
             self._server.stop(grace=1).wait()
             self._server = None
@@ -412,10 +446,14 @@ def main():  # python -m dlrover_tpu.master.job_master --port N --nodes N
     parser.add_argument("--min-nodes", type=int, default=0)
     parser.add_argument("--node-unit", type=int, default=1)
     parser.add_argument("--heartbeat-timeout", type=float, default=0.0)
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="HTTP scrape port for /metrics /timeline "
+                             "/healthz (0 = off)")
     args = parser.parse_args()
     master = JobMaster(
         port=args.port, num_nodes=args.nodes, node_unit=args.node_unit,
         min_nodes=args.min_nodes, heartbeat_timeout=args.heartbeat_timeout,
+        metrics_port=args.metrics_port,
     )
     master.start()
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)

@@ -40,6 +40,7 @@ class MasterServicer:
         timeline=None,
         auto_scaler=None,
         serve_frontend=None,
+        calibration=None,
     ):
         self.rdzv_managers = rdzv_managers or {}
         self.task_manager = task_manager
@@ -54,6 +55,9 @@ class MasterServicer:
         # submit/poll/cancel ride the same 2-RPC transport as the rest of
         # the control plane — no second server, no new wire format.
         self.serve_frontend = serve_frontend
+        # Calibration ledger (master/calibration.py): "calibration" wire
+        # events from profiled trainers fold in here.
+        self.calibration = calibration
         from dlrover_tpu.master.sync_service import SyncService
 
         self.sync_service = SyncService()
@@ -322,44 +326,67 @@ class MasterServicer:
             return
         node = p.node_id if p.node_id >= 0 else env.node_id
         self.timeline.add_events(node, p.events)
-        if self.speed_monitor is not None:
-            # Injected-fault events feed the Faultline ledger: a chaos
-            # run's lost time is attributed to the fault plan, not to the
-            # job.  Wire events are (name, kind, t_wall, duration_s, attrs).
-            for ev in p.events:
+        # Wire events are (name, kind, t_wall, duration_s, attrs).
+        for ev in p.events:
+            try:
+                name, _, _, duration_s, attrs = ev
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(attrs, dict):
+                continue
+            if self.speed_monitor is not None and name == "fault":
+                # Injected-fault events feed the Faultline ledger: a chaos
+                # run's lost time is attributed to the fault plan, not to
+                # the job.
+                self.speed_monitor.record_fault(
+                    str(attrs.get("seam", "?")),
+                    str(attrs.get("kind", "")),
+                    float(duration_s or 0.0),
+                )
+            elif self.speed_monitor is not None and name == "serve.swap":
+                # Weight hot-swap booking: versioned, with the
+                # rollback verdict — the serve ledger's swap counters
+                # (and gauges) come from here.
+                self.speed_monitor.record_swap(
+                    node,
+                    version=int(attrs.get("version", 0)),
+                    ok=bool(attrs.get("ok", False)),
+                    rolled_back=bool(attrs.get("rolled_back", False)),
+                    seconds=float(duration_s or 0.0),
+                )
+            elif self.speed_monitor is not None and name == "serve":
+                # Serving-replica stats snapshot: feeds the serve
+                # ledger behind dlrover_serve_* and the auto-scaler's
+                # latency/occupancy replica policy.
                 try:
-                    name, _, _, duration_s, attrs = ev
+                    self.speed_monitor.record_serve(node, **attrs)
                 except (TypeError, ValueError):
-                    continue
-                if name == "fault" and isinstance(attrs, dict):
-                    self.speed_monitor.record_fault(
-                        str(attrs.get("seam", "?")),
-                        str(attrs.get("kind", "")),
-                        float(duration_s or 0.0),
+                    logger.warning(
+                        "unparseable serve event from %d: %r",
+                        node, attrs,
                     )
-                elif name == "serve.swap" and isinstance(attrs, dict):
-                    # Weight hot-swap booking: versioned, with the
-                    # rollback verdict — the serve ledger's swap counters
-                    # (and gauges) come from here.
-                    self.speed_monitor.record_swap(
-                        node,
-                        version=int(attrs.get("version", 0)),
-                        ok=bool(attrs.get("ok", False)),
-                        rolled_back=bool(attrs.get("rolled_back", False)),
-                        seconds=float(duration_s or 0.0),
-                    )
-                elif name == "serve" and isinstance(attrs, dict):
-                    # Serving-replica stats snapshot: feeds the serve
-                    # ledger behind dlrover_serve_* and the auto-scaler's
-                    # latency/occupancy replica policy.
+            elif self.calibration is not None and name == "calibration":
+                # One measured/modeled pairing per capture window (flat
+                # float attrs; utils/device_profile emits them) folds
+                # into the per-cache-key EWMA correction ledger.
+                key = str(attrs.get("cache_key", ""))
+                for kind in ("compute", "collective"):
                     try:
-                        self.speed_monitor.record_serve(node, **attrs)
+                        self.calibration.observe(
+                            key, kind,
+                            float(attrs.get(f"measured_{kind}", 0.0)),
+                            float(attrs.get(f"modeled_{kind}", 0.0)),
+                        )
                     except (TypeError, ValueError):
                         logger.warning(
-                            "unparseable serve event from %d: %r",
+                            "unparseable calibration event from %d: %r",
                             node, attrs,
                         )
         if p.dropped:
+            # Make ring overflow visible master-side: the gauge
+            # dlrover_telemetry_dropped_total accumulates what the log
+            # line alone used to swallow.
+            self.timeline.bump("telemetry_dropped", p.dropped)
             logger.warning(
                 "node %d telemetry ring overwrote %d events before this "
                 "drain (raise DLROVER_TPU_TELEMETRY_RING?)",
@@ -372,6 +399,7 @@ class MasterServicer:
         return self.timeline.render_metrics(
             speed_monitor=self.speed_monitor,
             node_manager=self.node_manager,
+            calibration=self.calibration,
         )
 
     def _get_timeline(self, env: msg.Envelope):

@@ -194,6 +194,14 @@ class SpeedMonitor:
                 "tokens": float(tokens),
             }
 
+    def evict_serve(self, node_id: int):
+        """Drop a retired replica's stats snapshot so a drained/killed
+        replica stops counting toward ``dlrover_serve_replicas`` and the
+        fleet's latency/QPS aggregates (paired with
+        ``JobTimeline.evict_node`` at the fleet's retire hook)."""
+        with self._lock:
+            self._serve_stats.pop(node_id, None)
+
     def record_swap(
         self,
         node_id: int = 0,

@@ -74,6 +74,10 @@ class MasterStateStore:
             # gauges, so they ride the same snapshot.
             "serve": master.speed_monitor.serve_state(),
             "resize": master.speed_monitor.resize_state(),
+            # Calibration ratios are learned from profiler capture windows
+            # at a slow cadence — relearning them after a master restart
+            # would leave the tuner uncorrected for hours.
+            "calibration": master.calibration.state(),
         }
 
     def save(self, master):
@@ -145,6 +149,8 @@ class MasterStateStore:
             master.speed_monitor.restore_serve_state(state["serve"])
         if state.get("resize"):
             master.speed_monitor.restore_resize_state(state["resize"])
+        if state.get("calibration"):
+            master.calibration.restore(state["calibration"])
         if state.get("global_step"):
             master.speed_monitor.collect_global_step(
                 state["global_step"], timestamp=time.time()

@@ -53,6 +53,9 @@ class JobTimeline:
         self._step_order: Deque[int] = deque()
         # Lifecycle counters folded out of agent event streams.
         self._restart_counts: Counter = Counter()
+        # Free-form master-side counters (telemetry drops, perf
+        # regressions): bump() feeds them, render_metrics exposes them.
+        self._counters: Counter = Counter()
 
     # -- ingestion ------------------------------------------------------------
 
@@ -105,6 +108,18 @@ class JobTimeline:
             self._restart_counts.pop(node_id, None)
             for per_node in self._step_durations.values():
                 per_node.pop(node_id, None)
+
+    def bump(self, name: str, n: int = 1):
+        """Increment a master-side counter (rendered as
+        ``dlrover_<name>_total``)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._counters[name] += int(n)
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
 
     # -- queries --------------------------------------------------------------
 
@@ -181,6 +196,19 @@ class JobTimeline:
                     out[node_id] += 1
         return dict(out)
 
+    def step_time_series(self, last_n: int = 0) -> List[tuple]:
+        """Ordered ``(step, duration_s)`` pairs over the attribution
+        window.  The job-level duration of a step is the MAX across its
+        reporting nodes — the job moves at its slowest participant's pace
+        (the StepRegressionOperator's drift input)."""
+        with self._lock:
+            series = [
+                (step, max(self._step_durations[step].values()))
+                for step in self._step_order
+                if self._step_durations.get(step)
+            ]
+        return series[-last_n:] if last_n > 0 else series
+
     def steps_observed(self) -> int:
         """Multi-node steps inside the attribution window."""
         with self._lock:
@@ -197,6 +225,7 @@ class JobTimeline:
         self,
         speed_monitor=None,
         node_manager=None,
+        calibration=None,
     ) -> str:
         """Prometheus text exposition of the merged job state.
 
@@ -312,6 +341,23 @@ class JobTimeline:
             else:
                 gauge("dlrover_numeric_anomalies_recent", 0)
 
+        if calibration is not None and len(calibration):
+            lines.append(
+                "# HELP dlrover_calibration_ratio measured/modeled device "
+                "seconds per phase kind (EWMA over capture windows; 1.0 = "
+                "the cost model priced it perfectly)"
+            )
+            lines.append("# TYPE dlrover_calibration_ratio gauge")
+            for phase, ratio in sorted(calibration.ratios().items()):
+                gauge("dlrover_calibration_ratio", ratio,
+                      labels=f'{{phase="{phase}"}}')
+        with self._lock:
+            dropped = self._counters.get("telemetry_dropped", 0)
+            regressions = self._counters.get("perf_regressions", 0)
+        gauge("dlrover_telemetry_dropped_total", dropped,
+              "events the node telemetry rings overwrote before a drain")
+        gauge("dlrover_perf_regressions_total", regressions,
+              "step-time regressions flagged by the diagnosis sentinel")
         stats = self.step_stats()
         if stats:
             lines.append(

@@ -65,6 +65,7 @@ class ReplicaFleet:
         min_replicas: int = 1,
         spawn: Optional[Callable[[], ServingEngine]] = None,
         clock: Callable[[], float] = time.monotonic,
+        retire_hook: Optional[Callable[[str], None]] = None,
     ):
         self._clock = clock
         self.stale_after_s = stale_after_s
@@ -88,6 +89,19 @@ class ReplicaFleet:
         self.deaths = 0
         self.resubmitted = 0
         self.retired = 0
+        # Called with the rid after ANY registry exit (drain or kill) —
+        # the master wires observability eviction here so retired
+        # replicas drop their timeline/serve-ledger series like retired
+        # nodes do.  Best-effort: a hook failure never breaks the exit.
+        self.retire_hook = retire_hook
+
+    def _notify_retired(self, rid: str):
+        if self.retire_hook is None:
+            return
+        try:
+            self.retire_hook(rid)
+        except Exception as e:  # noqa: BLE001 - observability only
+            logger.warning("fleet: retire hook failed for %s: %s", rid, e)
 
     # -- registry -------------------------------------------------------------
 
@@ -234,6 +248,7 @@ class ReplicaFleet:
             "replica.death", replica=rid, reason=reason,
             resubmitted=requeued, survivors=len(self._replicas),
         )
+        self._notify_retired(rid)
 
     def resubmit_orphans(self) -> int:
         """Re-dispatch uids whose replica no longer exists (a total-loss
@@ -307,6 +322,7 @@ class ReplicaFleet:
         self._harvest(replica)
         self._replicas.pop(rid, None)
         self.retired += 1
+        self._notify_retired(rid)
         logger.info("fleet: replica %s drained and retired", rid)
 
     def maybe_scale(self, policy) -> Optional[str]:

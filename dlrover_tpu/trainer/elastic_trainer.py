@@ -106,6 +106,14 @@ class TrainerConfig:
     # bitwise-identical state, so a minority digest pins the corrupting
     # host.  0 disables: no digest program is built, nothing is allocated.
     sdc_check_every: int = 0
+    # -- device-time capture ---------------------------------------------------
+    # Every N steps, wrap ONE step in a ``jax.profiler`` trace window and
+    # emit the parsed per-phase device seconds as ``source="measured"``
+    # timeline rows + a calibration event (utils/device_profile.py).  The
+    # captured step pays one host<->device sync plus the trace write and
+    # parse; 0 disables — no profiler object is built and the step path
+    # allocates nothing.
+    profile_every: int = 0
     # World size ``grad_accum`` was chosen for; 0 = the world at first
     # construction.  Booked in checkpoint `extra` so a restore into a
     # different world recomputes N from the ORIGINAL reference pairing.
@@ -242,6 +250,13 @@ class ElasticTrainer:
         # the fit loop's loader (for the sampler rebind).
         self._prefetcher = None
         self._active_loader = None
+        # Device-time capture: None when off, so the step path pays one
+        # attribute read and nothing else.
+        self._device_profiler = None
+        if config.profile_every > 0:
+            from dlrover_tpu.utils.device_profile import DeviceProfiler
+
+            self._device_profiler = DeviceProfiler(config.profile_every)
         self.grad_accum = self._resolve_grad_accum()
         if self.grad_accum != self._ref_accum:
             logger.info(
@@ -602,20 +617,20 @@ class ElasticTrainer:
         # dispatch, plus any backpressure XLA applies when the device falls
         # behind — exactly the per-node signal the master's step-skew
         # attribution compares across hosts.
+        prof = self._device_profiler
+        capturing = prof is not None and prof.arm(self.step + 1)
         t_span = time.monotonic()
         with telemetry.span("step", step=self.step + 1):
-            placed = train_lib.shard_batch(batch, self.train)
-            t0 = time.perf_counter()
-            self.state, metrics = self.train.step(self.state, placed)
-            self.step += 1
-            pipeline_counters().record_dispatch(
-                self.step, time.perf_counter() - t0
-            )
-            every = self.config.sdc_check_every
-            if every > 0 and self.step % every == 0:
-                # Booked inside the step span: the digest dispatch is part
-                # of the step's host-observed cost at its check cadence.
-                self._sdc_check()
+            if capturing:
+                # The annotation marks the step in the device trace; it is
+                # a host-side profiler row, not a traced op — the compiled
+                # step program is untouched (no-retrace contract holds).
+                with prof.annotation("step"):
+                    metrics = self._dispatch_step(batch)
+            else:
+                metrics = self._dispatch_step(batch)
+        if capturing:
+            self._finish_capture(t_span)
         if (
             self.train.grad_accum > 1 or self.train.zero1
         ) and telemetry.recorder().enabled:
@@ -635,6 +650,78 @@ class ElasticTrainer:
                 )
         self._last_metrics = metrics
         return metrics
+
+    def _dispatch_step(self, batch: Dict[str, Any]):
+        placed = train_lib.shard_batch(batch, self.train)
+        t0 = time.perf_counter()
+        self.state, metrics = self.train.step(self.state, placed)
+        self.step += 1
+        pipeline_counters().record_dispatch(
+            self.step, time.perf_counter() - t0
+        )
+        every = self.config.sdc_check_every
+        if every > 0 and self.step % every == 0:
+            # Booked inside the step span: the digest dispatch is part
+            # of the step's host-observed cost at its check cadence.
+            self._sdc_check()
+        return metrics
+
+    # -- device-time capture ---------------------------------------------------
+
+    def _current_cache_key(self) -> str:
+        """The live step program's compile-cache key — the calibration
+        ledger's bucketing key.  Recomputed on demand: ``_build_train``
+        also keys OTHER folds during prewarm/relayout, so nothing it
+        stores could be trusted to describe the running program."""
+        if not self._cacheable:
+            return ""
+        config = self.config
+        return compile_cache.train_cache_key(
+            self.model_config, self.mesh.devices.shape,
+            global_batch_size=config.global_batch_size,
+            seq_len=config.seq_len,
+            ce_chunks=config.ce_chunks,
+            optimizer=(
+                f"{config.optimizer}/lr={config.learning_rate!r}"
+                f"/warmup={config.warmup_steps}"
+                f"/decay={config.decay_steps}"
+            ),
+            grad_accum=self.grad_accum,
+            accum_dtype=config.accum_dtype,
+            reduce_quant=config.reduce_quant,
+            zero1=config.zero1,
+            logical_shape=self.vmesh.logical_shape,
+        )
+
+    def _finish_capture(self, t_span: float):
+        """Close the armed profiler window: block on the step's outputs so
+        the device work lands inside the trace, then parse it and book the
+        measured rows + calibration event.  Strictly best-effort — a
+        failed window must never take the step down with it."""
+        from dlrover_tpu.utils import device_profile
+
+        # The capture sync is a deliberate host stall (the window must
+        # close after the device finished) — book it as a host block so
+        # the pipeline counters price what profiling costs the step loop.
+        with pipeline_counters().host_block("profile-sync", steps=(self.step,)):
+            try:
+                jax.block_until_ready(self.state)
+            except Exception as e:  # noqa: BLE001 — surface via the step
+                logger.warning("device capture sync failed: %s", e)
+        wall = time.monotonic() - t_span
+        window = self._device_profiler.finish()
+        if window is None:
+            return
+        # The modeled baseline for the SAME wall the window measured —
+        # the calibration ratio compares like with like.
+        rows = train_lib.microbatch_phase_plan(
+            self.train.grad_accum, self.train.reduce_quant, wall,
+            zero1=self.train.zero1,
+        )
+        device_profile.emit_measured_phases(
+            window, step=self.step, t_span=t_span, wall_s=wall,
+            modeled_rows=rows, cache_key=self._current_cache_key(),
+        )
 
     # -- silent data corruption ------------------------------------------------
 
@@ -953,7 +1040,12 @@ class ElasticTrainer:
                 anomalies=anomalies,
             )
             # Piggyback the telemetry drain on the report cadence: one
-            # extra RPC per report window, never per step.
+            # extra RPC per report window, never per step.  Snapshot the
+            # ring's drop count before ship() zeroes it — the pipeline
+            # counters keep the worker-local lifetime tally.
+            dropped = telemetry.recorder().dropped
+            if dropped:
+                pipeline_counters().record_dropped(dropped)
             telemetry.recorder().ship(self.client)
             if self._pending_digests:
                 # Digest fetch + ship rides the same cadence: the uint32

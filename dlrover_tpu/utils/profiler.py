@@ -263,6 +263,11 @@ class StepPipelineCounters:
             self.place_count = 0
             self.dispatch_count = 0
             self.dispatch_s = 0.0
+            # Telemetry-ring overflow: events the bounded ring discarded
+            # before a ship drained it (lifetime tally; the per-window
+            # count also rides the wire to the master's
+            # dlrover_telemetry_dropped_total gauge).
+            self.dropped_events = 0
 
     @contextlib.contextmanager
     def host_block(self, label: str, steps: Sequence[int] = ()):
@@ -295,6 +300,12 @@ class StepPipelineCounters:
         if duration_s > 0.0:
             _telemetry.event(label, duration_s=duration_s, kind="place",
                              batch=index)
+
+    def record_dropped(self, count: int):
+        if count <= 0:
+            return
+        with self._lock:
+            self.dropped_events += count
 
     def record_dispatch(self, step: int, duration_s: float):
         with self._lock:
@@ -361,6 +372,7 @@ class StepPipelineCounters:
                 "place_count": self.place_count,
                 "dispatch_count": self.dispatch_count,
                 "dispatch_s": self.dispatch_s,
+                "dropped_events": self.dropped_events,
             }
 
 

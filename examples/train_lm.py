@@ -106,6 +106,11 @@ def parse_args():
                    help="write this process's telemetry (step/compile/"
                         "checkpoint spans) as a Chrome-trace JSON at exit "
                         "— open at https://ui.perfetto.dev")
+    p.add_argument("--profile-every", type=int, default=0,
+                   help="capture a jax.profiler trace window every N steps "
+                        "and emit measured per-phase device rows next to "
+                        "the modeled ones (0 = off; the captured step pays "
+                        "one device sync + the trace parse)")
     return p.parse_args()
 
 
@@ -157,6 +162,7 @@ def main():
             reduce_quant=args.reduce_quant,
             zero1=args.zero1,
             sdc_check_every=args.sdc_check_every,
+            profile_every=args.profile_every,
             world=args.ref_world,
             grad_accum_ref_world=args.ref_world,
         ),

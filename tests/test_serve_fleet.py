@@ -182,6 +182,35 @@ def test_maybe_scale_out_and_in(setup):
     assert len(fleet._replicas) == 1 and fleet.retired == 1
 
 
+def test_scale_in_evicts_retired_replica_observability(setup):
+    """Satellite regression: replicas leaving the fleet (drain/scale-in
+    AND death) must drop their timeline + serve-ledger series — the same
+    contract node retirement has (mirrors
+    test_scale_down_evicts_observability_series)."""
+    from dlrover_tpu.master.job_master import JobMaster
+
+    master = JobMaster(num_nodes=2, auto_scale=False)
+    fleet = ReplicaFleet()
+    fleet.add_replica(_engine(setup))
+    fleet.add_replica(_engine(setup, seed=1))
+    master.attach_serve_frontend(ServeFrontend(fleet))
+    assert fleet.retire_hook is not None
+    for node in (0, 1):
+        master.speed_monitor.record_serve(node, qps=2.0, requests=4.0)
+        master.timeline.record(node, "step", kind="span", duration_s=0.1,
+                               attrs={"step": 3})
+    assert master.speed_monitor.serve_ledger()["replicas"] == 2.0
+    # Scale-in path: drain retires replica-1 -> its series go.
+    fleet.drain("replica-1")
+    assert fleet.retired == 1
+    assert master.speed_monitor.serve_ledger()["replicas"] == 1.0
+    assert master.timeline.nodes() == [0]
+    # Death path: kill exits the registry through the same hook.
+    fleet.kill("replica-0", reason="test")
+    assert master.speed_monitor.serve_ledger()["replicas"] == 0.0
+    assert master.timeline.nodes() == []
+
+
 def test_cancel_hits_only_queued_requests(setup):
     fleet = ReplicaFleet()
     fleet.add_replica(_engine(setup, slots=1))

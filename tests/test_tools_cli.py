@@ -209,6 +209,55 @@ def test_serve_fleet_gate_predicate():
     assert not ok and failed == ["p95_recovered_under_slo"]
 
 
+def test_metrics_scrape_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_scrape.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--url" in out.stdout and "--timeline-out" in out.stdout
+
+
+def test_metrics_scrape_against_live_plane(tmp_path, monkeypatch, capsys):
+    """End-to-end: the scrape CLI against a real in-process HTTP plane —
+    every endpoint answers and the timeline lands on disk."""
+    from dlrover_tpu.master.http_plane import MetricsHTTPServer
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.timeline import JobTimeline
+
+    timeline = JobTimeline()
+    timeline.record(0, "step", kind="span", duration_s=0.1,
+                    attrs={"step": 1})
+    plane = MetricsHTTPServer(
+        MasterServicer(timeline=timeline), host="127.0.0.1", port=0
+    )
+    port = plane.start()
+    tool = _load_module(
+        os.path.join(REPO, "tools", "metrics_scrape.py"), "_metrics_scrape"
+    )
+    out = tmp_path / "timeline.json"
+    monkeypatch.setattr(sys, "argv", [
+        "metrics_scrape.py", "--url", f"http://127.0.0.1:{port}",
+        "--timeline-out", str(out),
+    ])
+    try:
+        assert tool.main() == 0
+    finally:
+        plane.stop()
+    report = capsys.readouterr().out
+    assert "healthz: ok=True" in report
+    assert "metrics:" in report and "FAILED" not in report
+    trace = json.loads(out.read_text())
+    assert any(e.get("name") == "step" for e in trace["traceEvents"])
+    # A dead endpoint is a nonzero exit, not a crash.
+    monkeypatch.setattr(sys, "argv", [
+        "metrics_scrape.py", "--url", f"http://127.0.0.1:{port}",
+        "--timeout", "0.5",
+    ])
+    assert tool.main() == 1
+
+
 def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):
     events = {
         "0": [["step", "span", 10.0, 0.2, {"src": "trainer", "step": 1}],

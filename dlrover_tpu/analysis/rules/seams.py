@@ -7,13 +7,15 @@ can exercise it.  That only works if the convention holds — a raw
 ``open(..., "w")`` or ``os.replace`` added to ``agent/`` without a
 ``fire`` call is a failure path the chaos drills (resize, SDC, bitflip)
 can never reach, and the first time it breaks is in production.  This
-rule turns the convention into a checked property.
+rule turns the convention into a checked property.  PR 15 added the
+``embedding/`` tier: the spill log and sharded-table export are
+remote-storage-shaped I/O exactly like the checkpoint paths.
 
 A raw I/O call (write-mode ``open``, read-mode ``open`` of anything but
 a ``/proc/`` literal, ``os.replace``/``os.rename``, ``shutil.*``,
 ``socket.*`` connection constructors, ``urlopen``, ``requests.*``)
 inside the fault-handling tiers (``agent/``,
-``master/``, ``checkpoint/``, ``data/``) fires unless its enclosing
+``master/``, ``checkpoint/``, ``data/``, ``embedding/``) fires unless its enclosing
 function also fires a *registered* seam — the seam registry is parsed
 from ``common/faults.py``'s ``KNOWN_SEAMS`` tuple, so inventing an
 unregistered seam name doesn't count as coverage.  Module-level raw I/O
@@ -34,7 +36,7 @@ from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
 #: Tiers where unseamed I/O hides from the fault drills (substring match,
 #: same idiom as RTY001's SWALLOW_SCOPES).
 SEAM_SCOPES: Tuple[str, ...] = (
-    "agent/", "master/", "checkpoint/", "data/",
+    "agent/", "master/", "checkpoint/", "data/", "embedding/",
 )
 
 #: Fallback registry when common/faults.py cannot be parsed (fixtures).
@@ -43,6 +45,7 @@ FALLBACK_SEAMS: Tuple[str, ...] = (
     "saver.persist", "saver.flush", "backend.init", "coworker.fetch",
     "preempt.notice", "rdzv.join", "sdc.flip", "serve.admit",
     "serve.rpc", "serve.swap", "replica.death", "http.serve",
+    "embed.fetch", "embed.reshard",
 )
 
 #: Dotted call names that are raw I/O regardless of arguments.
@@ -171,7 +174,8 @@ class UnseamedRawIO(Rule):
     name = "unseamed-raw-io"
     description = (
         "raw I/O in a fault-handling tier (agent/master/checkpoint/"
-        "data) with no registered Faultline seam fired in the enclosing "
+        "data/embedding) with no registered Faultline seam fired in the "
+        "enclosing "
         "function; the fault drills cannot reach this failure path"
     )
 

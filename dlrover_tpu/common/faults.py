@@ -34,7 +34,7 @@ Known seams (see PROFILE.md "Faultline" for the incident each models):
 ``saver.persist``, ``saver.flush``, ``backend.init``, ``coworker.fetch``,
 ``preempt.notice``, ``rdzv.join``, ``sdc.flip``, ``serve.admit``,
 ``tpu.api``, ``relayout.apply``, ``serve.rpc``, ``serve.swap``,
-``replica.death``, ``http.serve``.
+``replica.death``, ``http.serve``, ``embed.fetch``, ``embed.reshard``.
 """
 
 from __future__ import annotations
@@ -107,6 +107,16 @@ KNOWN_SEAMS = (
     # scraper 503 exactly like a wedged master, delay kinds model slow
     # scrapes holding handler threads.
     "http.serve",
+    # Embedding-plane fetch seam (embedding/sharded.py): fires once per
+    # owner a sharded lookup / gradient push exchanges rows with — an
+    # error kind models a peer host that dropped the batch's row exchange,
+    # delay kinds model a straggling parameter host.
+    "embed.fetch",
+    # Embedding-plane reshard seam: fires at the top of every bucket-map
+    # re-fold (world resize); an error kind aborts the row migration
+    # before any owner mutates, so the retrying caller re-enters with the
+    # old fold intact.
+    "embed.reshard",
 )
 
 

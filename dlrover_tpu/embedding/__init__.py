@@ -1,2 +1,10 @@
 from dlrover_tpu.embedding.table import EmbeddingTable  # noqa: F401
 from dlrover_tpu.embedding.store import KVStore  # noqa: F401
+from dlrover_tpu.embedding.sharded import (  # noqa: F401
+    ShardedEmbeddingTable,
+    hash_bucket,
+)
+from dlrover_tpu.embedding.device_cache import (  # noqa: F401
+    DeviceHotRowCache,
+    EmbeddingPrefetcher,
+)

@@ -515,6 +515,56 @@ int64_t kv_count_since(void* handle, uint32_t min_step) {
   return n;
 }
 
+// Targeted removal (the reshard row-move path: rows that changed owner are
+// deleted at the source after the destination acknowledges the insert).
+// Open addressing with linear probing cannot tombstone without poisoning
+// every future probe chain, so holes are healed by backward-shift deletion:
+// entries after the hole whose home slot does not lie cyclically within
+// (hole, entry] slide back into it.  Returns the number actually removed.
+int64_t kv_remove(void* handle, const int64_t* rm_keys, int64_t n) {
+  Store* s = static_cast<Store*>(handle);
+  int64_t removed = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    uint64_t key = static_cast<uint64_t>(rm_keys[r]);
+    if (key == kEmpty) {
+      if (s->has_min) {
+        s->has_min = false;
+        s->min_count = 0;
+        s->min_step = 0;
+        memset(s->min_payload, 0, s->payload_width() * sizeof(float));
+        removed += 1;
+      }
+      continue;
+    }
+    int64_t slot = s->find_slot(key);
+    if (slot < 0) continue;
+    uint64_t mask = static_cast<uint64_t>(s->capacity) - 1;
+    uint64_t hole = static_cast<uint64_t>(slot);
+    s->keys[hole] = kEmpty;
+    uint64_t j = hole;
+    while (true) {
+      j = (j + 1) & mask;
+      if (s->keys[j] == kEmpty) break;
+      uint64_t home = mix64(s->keys[j]) & mask;
+      // Reachable from its home without passing the hole? Then leave it.
+      bool in_range = (hole < j) ? (home > hole && home <= j)
+                                 : (home > hole || home <= j);
+      if (in_range) continue;
+      s->keys[hole] = s->keys[j];
+      memcpy(s->payload + hole * s->payload_width(),
+             s->payload + j * s->payload_width(),
+             s->payload_width() * sizeof(float));
+      s->counts[hole] = s->counts[j];
+      s->steps[hole] = s->steps[j];
+      s->keys[j] = kEmpty;
+      hole = j;
+    }
+    s->size -= 1;
+    removed += 1;
+  }
+  return removed;
+}
+
 // Evict entries not touched since `min_step` with fewer than `min_count`
 // hits (feature-freshness eviction, ref kv_variable.h delete/filter ops).
 // Rebuilds the table; returns evicted count.

@@ -25,11 +25,66 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults, telemetry
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.embedding.store import KVStore
 
 _HEADER = struct.Struct("<qIIi")  # key, count, step, payload_bytes
 _TOMBSTONE = -1                   # payload_bytes sentinel: key deleted
+
+
+def pack_records(keys, rows, m, v, counts, steps) -> bytes:
+    """Serialize rows in the spill-log record format (header + fp32
+    value|m|v payload per key) — also the owner-to-owner wire format the
+    reshard row moves ride, so one record codec serves both the disk tier
+    and the transport."""
+    keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+    out = []
+    for i, key in enumerate(keys.tolist()):
+        payload = np.concatenate([
+            np.asarray(a[i], np.float32).reshape(-1) for a in (rows, m, v)
+        ]).tobytes()
+        out.append(_HEADER.pack(
+            int(key), int(counts[i]), int(steps[i]), len(payload)
+        ))
+        out.append(payload)
+    return b"".join(out)
+
+
+def unpack_records(data: bytes, dim: int):
+    """Inverse of :func:`pack_records`: bytes -> (keys, rows, m, v,
+    counts, steps) numpy arrays.  Raises ``ValueError`` on a short or
+    malformed stream — a torn transport buffer must not half-apply."""
+    payload_bytes = 3 * dim * 4
+    keys, rows, m, v, counts, steps = [], [], [], [], [], []
+    offset = 0
+    while offset < len(data):
+        if offset + _HEADER.size > len(data):
+            raise ValueError("truncated record header in reshard stream")
+        key, count, step, nbytes = _HEADER.unpack_from(data, offset)
+        offset += _HEADER.size
+        if nbytes != payload_bytes or offset + nbytes > len(data):
+            raise ValueError(
+                f"malformed record for key {key}: payload {nbytes} != "
+                f"{payload_bytes} or stream truncated"
+            )
+        payload = np.frombuffer(data, np.float32, 3 * dim, offset)
+        offset += nbytes
+        keys.append(key)
+        rows.append(payload[:dim])
+        m.append(payload[dim: 2 * dim])
+        v.append(payload[2 * dim: 3 * dim])
+        counts.append(count)
+        steps.append(step)
+    empty = np.empty((0, dim), np.float32)
+    return (
+        np.asarray(keys, np.int64),
+        np.stack(rows) if rows else empty,
+        np.stack(m) if m else empty.copy(),
+        np.stack(v) if v else empty.copy(),
+        np.asarray(counts, np.uint32),
+        np.asarray(steps, np.uint32),
+    )
 
 
 class SpillFile:
@@ -43,6 +98,9 @@ class SpillFile:
         self._payload = 3 * dim * 4  # value + m + v, fp32
         if os.path.exists(path):
             self._rebuild_index()
+        # The append handle is the spill tier's write path; a full disk or
+        # yanked mount surfaces here, so the drills must be able to reach it.
+        faults.fire("storage.write", path=path, op="spill.open")
         self._file = open(path, "ab")
         self._reader = open(path, "rb")
 
@@ -56,6 +114,7 @@ class SpillFile:
         return list(self._index.keys())
 
     def _rebuild_index(self):
+        faults.fire("storage.read", path=self.path, op="spill.rebuild")
         size = os.path.getsize(self.path)
         with open(self.path, "rb") as f:
             while True:
@@ -84,6 +143,7 @@ class SpillFile:
             [np.asarray(a, np.float32).reshape(-1) for a in (row, m, v)]
         ).tobytes()
         assert len(payload) == self._payload
+        faults.fire("storage.write", path=self.path, op="spill.append")
         offset = self._file.tell()
         self._file.write(
             _HEADER.pack(int(key), int(count), int(step), len(payload))
@@ -103,6 +163,7 @@ class SpillFile:
         offset = self._index.get(int(key))
         if offset is None:
             return None
+        faults.fire("storage.read", path=self.path, op="spill.read")
         self.flush()  # the reader must see everything appended so far
         self._reader.seek(offset)
         _, count, step, nbytes = _HEADER.unpack(
@@ -128,6 +189,7 @@ class SpillFile:
         self.flush()
         live = list(self._index.keys())
         tmp = self.path + ".compact"
+        faults.fire("storage.write", path=self.path, op="spill.compact")
         with open(tmp, "wb") as out:
             new_index: Dict[int, int] = {}
             for key in live:
@@ -248,7 +310,7 @@ class HybridKVStore:
 
     def spill(self, min_step: int, min_count: int = 0) -> int:
         """Demote features colder than the thresholds to the disk tier."""
-        with self._mu:
+        with self._mu, telemetry.span("embed.spill") as sp:
             keys, rows, m, v, counts, steps = self.ram.export()
             cold = [
                 i for i in range(keys.size)
@@ -264,6 +326,9 @@ class HybridKVStore:
                 # so the spilled rows must be on stable storage first.
                 self.disk.flush(durable=True)
                 self.ram.evict(min_step, min_count)
+            if sp is not None:
+                sp.attrs["rows"] = len(cold)
+                sp.attrs["bytes"] = len(cold) * (3 * self.dim * 4 + 20)
             return len(cold)
 
     def export(self, min_step: int = 0):
@@ -304,6 +369,20 @@ class HybridKVStore:
             for key in np.asarray(keys, np.int64).reshape(-1).tolist():
                 self.disk.remove(int(key))
             self.disk.flush()
+
+    def remove(self, keys) -> int:
+        """Delete specific keys from whichever tier holds them (reshard
+        row-move path); disk copies are tombstoned so an index rebuild
+        cannot resurrect a row that migrated to another owner."""
+        with self._mu:
+            keys = np.asarray(keys, np.int64).reshape(-1)
+            removed = self.ram.remove(keys)
+            for key in keys.tolist():
+                if key in self.disk:
+                    self.disk.remove(int(key))
+                    removed += 1
+            self.disk.flush()
+            return removed
 
     def evict(self, min_step: int, min_count: int = 0) -> int:
         """Destructive eviction across BOTH tiers."""

@@ -25,15 +25,22 @@ _LIB = os.path.join(_NATIVE_DIR, "libkvstore.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
+# A transient compiler failure (ENOSPC, an OOM-killed cc1plus) must not
+# permanently demote the process to the NumPy fallback: the first failure
+# logs and leaves the latch open so the NEXT _load_native call retries the
+# build once; only the second consecutive failure latches _lib_failed.
+_MAX_BUILD_ATTEMPTS = 2
+_build_attempts = 0
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_failed
+    global _lib, _lib_failed, _build_attempts
     if _lib is not None or _lib_failed:
         return _lib
     with _build_lock:
         if _lib is not None or _lib_failed:
             return _lib
+        _build_attempts += 1
         try:
             if not os.path.exists(_LIB) or (
                 os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
@@ -44,11 +51,19 @@ def _load_native() -> Optional[ctypes.CDLL]:
                 )
             lib = ctypes.CDLL(_LIB)
         except (OSError, subprocess.CalledProcessError) as e:
-            logger.warning(
-                "kv_store native build unavailable (%s); using the NumPy "
-                "fallback", getattr(e, "stderr", e),
-            )
-            _lib_failed = True
+            if _build_attempts >= _MAX_BUILD_ATTEMPTS:
+                _lib_failed = True
+                logger.warning(
+                    "kv_store native build failed again (%s); disabling "
+                    "the native path for this process (NumPy fallback)",
+                    getattr(e, "stderr", e),
+                )
+            else:
+                logger.warning(
+                    "kv_store native build unavailable (%s); using the "
+                    "NumPy fallback for now, will retry the build once on "
+                    "the next native request", getattr(e, "stderr", e),
+                )
             return None
         c = ctypes
         i64, u32, u64, f32p = c.c_int64, c.c_uint32, c.c_uint64, c.POINTER(c.c_float)
@@ -93,6 +108,8 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib.kv_count_since.argtypes = [c.c_void_p, u32]
         lib.kv_evict.restype = i64
         lib.kv_evict.argtypes = [c.c_void_p, u32, u32]
+        lib.kv_remove.restype = i64
+        lib.kv_remove.argtypes = [c.c_void_p, i64p, i64]
         _lib = lib
     return _lib
 
@@ -461,6 +478,24 @@ class KVStore:
                     int(counts[i]) if counts is not None else 0,
                     int(steps[i]) if steps is not None else 0,
                 )
+
+    def remove(self, keys: np.ndarray) -> int:
+        """Delete specific keys — the reshard row-move path drops rows at
+        their old owner once the new owner holds them.  Returns how many
+        were present and removed; absent keys are ignored."""
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        with self._mu:
+            if self._lib:
+                return int(self._lib.kv_remove(
+                    self._h(), _ptr(keys, ctypes.c_int64), keys.size,
+                ))
+            removed = 0
+            for key in keys.tolist():
+                if key in self._py:
+                    del self._py[key]
+                    del self._py_meta[key]
+                    removed += 1
+            return removed
 
     def evict(self, min_step: int, min_count: int = 0) -> int:
         """Drop stale, cold features; returns evicted count."""

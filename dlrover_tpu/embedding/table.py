@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.embedding.store import KVStore
 
@@ -211,6 +212,9 @@ class EmbeddingTable:
         kind = "delta" if delta else "full"
         path = os.path.join(directory, f"{self.name}_{kind}_{step}.kv")
         tmp = path + ".tmp"
+        # The same storage seam the checkpoint savers declare: a full disk
+        # or yanked mount during a table export is drillable fault input.
+        faults.fire("storage.write", path=path, op="table.save")
         with open(tmp, "wb") as f:
             f.write(self.state_blob(delta=delta))
         os.replace(tmp, path)
@@ -244,6 +248,7 @@ class EmbeddingTable:
             e for e in entries if e[1] == "delta" and e[0] > base_step
         )
         for step, kind, fname in replay:
+            faults.fire("storage.read", path=fname, op="table.restore")
             with open(os.path.join(directory, fname), "rb") as f:
                 self.load_blob(f.read())
         logger.info(

@@ -365,6 +365,17 @@ class MasterServicer:
                         "unparseable serve event from %d: %r",
                         node, attrs,
                     )
+            elif self.speed_monitor is not None and name == "embed":
+                # Embedding-plane stats snapshot: feeds the embed ledger
+                # behind the dlrover_embed_* gauges (rows owned, cache
+                # hit rate, reshard time).
+                try:
+                    self.speed_monitor.record_embed(node, **attrs)
+                except (TypeError, ValueError):
+                    logger.warning(
+                        "unparseable embed event from %d: %r",
+                        node, attrs,
+                    )
             elif self.calibration is not None and name == "calibration":
                 # One measured/modeled pairing per capture window (flat
                 # float attrs; utils/device_profile emits them) folds

@@ -89,6 +89,11 @@ class SpeedMonitor:
         self._swap_rollbacks = 0
         self._swap_s_total = 0.0
         self._weights_version = 0
+        # "embed" telemetry events: each reporter's newest plane-global
+        # snapshot (rows owned, fold clocks, cache hit rate) — the
+        # ``dlrover_embed_*`` gauges read the aggregate.
+        self._embed_stats: Dict[int, Dict[str, float]] = {}
+        self._embed_events = 0
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -223,6 +228,73 @@ class SpeedMonitor:
             self._swap_s_total += max(0.0, float(seconds))
             self._weights_version = max(self._weights_version, int(version))
 
+    def record_embed(
+        self,
+        node_id: int = 0,
+        *,
+        world: float = 0.0,
+        rows_owned: float = 0.0,
+        rows_owned_max: float = 0.0,
+        lookups: float = 0.0,
+        rows_fetched: float = 0.0,
+        reshards: float = 0.0,
+        reshard_s: float = 0.0,
+        moved_rows: float = 0.0,
+        spill_bytes: float = 0.0,
+        hit_rate: float = 0.0,
+        rows_per_s: float = 0.0,
+        **_ignored,
+    ):
+        """An embedding plane's stats snapshot (its ``embed`` telemetry
+        event).  Newest-wins per reporting node; unknown attrs are ignored
+        so the plane can grow the event without breaking older masters."""
+        with self._lock:
+            self._embed_events += 1
+            self._embed_stats[node_id] = {
+                "world": float(world),
+                "rows_owned": float(rows_owned),
+                "rows_owned_max": float(rows_owned_max),
+                "lookups": float(lookups),
+                "rows_fetched": float(rows_fetched),
+                "reshards": float(reshards),
+                "reshard_s": float(reshard_s),
+                "moved_rows": float(moved_rows),
+                "spill_bytes": float(spill_bytes),
+                "hit_rate": float(hit_rate),
+                "rows_per_s": float(rows_per_s),
+            }
+
+    def embed_ledger(self) -> Dict[str, float]:
+        """Embedding-plane aggregate.  Every reporter books the same
+        plane-GLOBAL snapshot (``ShardedEmbeddingTable.stats`` already sums
+        over owner hosts), so counters take the max across reporters —
+        summing would double-count a plane several agents report — and the
+        cache hit rate averages (it is the only per-reporter field)."""
+        with self._lock:
+            stats = list(self._embed_stats.values())
+            n = len(stats)
+
+            def top(key: str) -> float:
+                return max((s[key] for s in stats), default=0.0)
+
+            return {
+                "embed_events": float(self._embed_events),
+                "reporters": float(n),
+                "world": top("world"),
+                "rows_owned": top("rows_owned"),
+                "rows_owned_max": top("rows_owned_max"),
+                "lookups": top("lookups"),
+                "rows_fetched": top("rows_fetched"),
+                "reshards": top("reshards"),
+                "reshard_s": top("reshard_s"),
+                "moved_rows": top("moved_rows"),
+                "spill_bytes": top("spill_bytes"),
+                "hit_rate": (
+                    sum(s["hit_rate"] for s in stats) / n if n else 0.0
+                ),
+                "rows_per_s": top("rows_per_s"),
+            }
+
     def serve_ledger(self) -> Dict[str, float]:
         """Fleet aggregate: QPS/requests/tokens/slots sum across replicas,
         latency quantiles take the WORST replica (an SLO is breached when
@@ -277,6 +349,19 @@ class SpeedMonitor:
             self._weights_version = max(
                 self._weights_version, int(state.get("weights_version", 0))
             )
+
+    def embed_state(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "stats": {k: dict(v) for k, v in self._embed_stats.items()},
+                "events": self._embed_events,
+            }
+
+    def restore_embed_state(self, state: Dict[str, object]):
+        with self._lock:
+            for k, v in dict(state.get("stats", {})).items():
+                self._embed_stats[int(k)] = dict(v)
+            self._embed_events = int(state.get("events", 0))
 
     def resize_state(self) -> Dict[str, object]:
         with self._lock:

@@ -74,6 +74,7 @@ class MasterStateStore:
             # gauges, so they ride the same snapshot.
             "serve": master.speed_monitor.serve_state(),
             "resize": master.speed_monitor.resize_state(),
+            "embed": master.speed_monitor.embed_state(),
             # Calibration ratios are learned from profiler capture windows
             # at a slow cadence — relearning them after a master restart
             # would leave the tuner uncorrected for hours.
@@ -149,6 +150,8 @@ class MasterStateStore:
             master.speed_monitor.restore_serve_state(state["serve"])
         if state.get("resize"):
             master.speed_monitor.restore_resize_state(state["resize"])
+        if state.get("embed"):
+            master.speed_monitor.restore_embed_state(state["embed"])
         if state.get("calibration"):
             master.calibration.restore(state["calibration"])
         if state.get("global_step"):

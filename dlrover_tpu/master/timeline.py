@@ -318,6 +318,27 @@ class JobTimeline:
                   "hot-swaps rolled back on a digest mismatch")
             gauge("dlrover_serve_weights_version", serve["weights_version"],
                   "newest weights version any replica is serving")
+            embed = speed_monitor.embed_ledger()
+            gauge("dlrover_embed_rows_owned", embed["rows_owned"],
+                  "embedding rows resident across the plane's owner hosts")
+            gauge("dlrover_embed_rows_owned_max", embed["rows_owned_max"],
+                  "rows on the fullest owner host (fold skew)")
+            gauge("dlrover_embed_cache_hit_rate", embed["hit_rate"],
+                  "device hot-row cache hit rate (0..1, mean of reporters)")
+            gauge("dlrover_embed_lookups_total", embed["lookups"],
+                  "sharded embedding lookups performed")
+            gauge("dlrover_embed_rows_fetched_total", embed["rows_fetched"],
+                  "unique rows exchanged with owner hosts on lookups")
+            gauge("dlrover_embed_reshards_total", embed["reshards"],
+                  "elastic bucket-map re-folds performed")
+            gauge("dlrover_embed_reshard_seconds_total", embed["reshard_s"],
+                  "wall seconds spent moving rows between owners")
+            gauge("dlrover_embed_moved_rows_total", embed["moved_rows"],
+                  "rows that changed owner across all reshards")
+            gauge("dlrover_embed_spill_bytes", embed["spill_bytes"],
+                  "cold rows spilled to host-disk tiers, in bytes")
+            gauge("dlrover_embed_rows_per_s", embed["rows_per_s"],
+                  "embedding rows served/s (newest reported snapshot)")
             sdc = speed_monitor.sdc_ledger()
             gauge("dlrover_sdc_checks_total", sdc["checks"],
                   "cross-replica state-digest votes performed")

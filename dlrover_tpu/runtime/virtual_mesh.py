@@ -36,6 +36,14 @@ import numpy as np
 from dlrover_tpu.common.log import default_logger as logger
 
 
+def shard_owner(shard: int, physical_world: int) -> int:
+    """THE ownership rule: logical shard ``s`` lives on physical member
+    ``s % P``.  One function so every consumer of the fold — the virtual
+    mesh, the elastic sampler's inline copy, and the sharded embedding
+    plane's bucket→owner map — provably agrees."""
+    return shard % physical_world
+
+
 @dataclasses.dataclass(frozen=True)
 class VirtualMesh:
     """Fixed logical mesh of ``logical_world`` host-granular submeshes,
@@ -75,7 +83,7 @@ class VirtualMesh:
 
     def owner(self, shard: int) -> int:
         """Physical member hosting logical shard ``shard``."""
-        return shard % self.physical_world
+        return shard_owner(shard, self.physical_world)
 
     def owned_shards(self, rank: int) -> Tuple[int, ...]:
         """Logical shards folded onto physical member ``rank`` (empty when

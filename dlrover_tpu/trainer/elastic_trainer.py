@@ -250,6 +250,11 @@ class ElasticTrainer:
         # the fit loop's loader (for the sampler rebind).
         self._prefetcher = None
         self._active_loader = None
+        # Sparse embedding plane (embedding/sharded.py), if the model has
+        # one: its bucket→owner fold follows the dense world through every
+        # resize/restore, and its booking rides the checkpoint ``extra``.
+        self._embed_plane = None
+        self._embed_dir = None
         # Device-time capture: None when off, so the step path pays one
         # attribute read and nothing else.
         self._device_profiler = None
@@ -369,9 +374,44 @@ class ElasticTrainer:
             cache_key=cache_key,
         )
 
+    def attach_embedding_plane(self, plane, directory: str = None):
+        """Bind a ``ShardedEmbeddingTable`` to the trainer's elasticity.
+
+        From here on: the plane's bucket→owner booking rides every
+        checkpoint's ``extra``; a live resize re-folds the plane alongside
+        the dense state; a restore adopts the booked optimizer clocks and
+        folds the plane onto the live world.  With ``directory`` set,
+        every dense checkpoint also flushes the plane's delta export
+        there (the preemption-drain leg — rows touched since the last
+        export, under the integrity chain).
+
+        If the trainer already restored a checkpoint before the attach
+        (the normal construction order), the booking it carried is
+        adopted now.
+        """
+        self._embed_plane = plane
+        self._embed_dir = directory
+        if self._ckpt is not None:
+            self._adopt_embed_booking(self._ckpt.last_extra)
+
+    def _adopt_embed_booking(self, extra):
+        """Adopt a restored embed booking onto the LIVE world: clocks come
+        from the booking, but the fold target is this trainer's current
+        physical world — one reshard instead of a there-and-back through
+        the save-time world."""
+        plane = self._embed_plane
+        if plane is None or not extra:
+            return
+        booking = extra.get("embed")
+        if not booking:
+            return
+        booking = dict(booking)
+        booking["world"] = self._world
+        plane.adopt_booking(booking)
+
     def _accum_extra(self) -> Dict[str, Any]:
         """The microbatch-engine sidecar booked with every checkpoint."""
-        return {
+        extra = {
             "grad_accum": self.grad_accum,
             "grad_accum_ref": {
                 "accum": self._ref_accum, "world": self._ref_world,
@@ -382,6 +422,9 @@ class ElasticTrainer:
             "global_batch_size": self.config.global_batch_size,
             "world": self._world,
         }
+        if self._embed_plane is not None:
+            extra["embed"] = self._embed_plane.booking()
+        return extra
 
     def _adopt_checkpoint_accum(self, extra: Dict[str, Any]):
         """Recompute grad_accum from the checkpoint's booked reference.
@@ -393,6 +436,10 @@ class ElasticTrainer:
         schedule.  A changed N rebuilds the compiled program (state
         shardings are N-independent, so the restored state stays placed).
         """
+        # The embed booking adopts regardless of the grad-accum outcome —
+        # an unchanged microbatch schedule can still carry a plane whose
+        # optimizer clocks moved.
+        self._adopt_embed_booking(extra)
         ref = extra.get("grad_accum_ref") if extra else None
         if not ref:
             return
@@ -528,6 +575,15 @@ class ElasticTrainer:
             virtual_mesh.relayout_state(self.state, train.state_shardings)
         )
         moves = len(self.vmesh.relayout_plan(new_world))
+        # Re-fold an attached embedding plane onto the same new world.
+        # Its seam fires before any owner mutates and migration inserts
+        # before it removes, so a failure here aborts the attempt with
+        # the plane intact (or duplicated, never short) for the retry.
+        embed_moved = 0
+        if self._embed_plane is not None:
+            embed_moved = self._embed_plane.reshard(
+                new_world
+            )["moved_rows"]
         self.vmesh = vmesh
         self._world = new_world
         self.grad_accum = accum
@@ -538,6 +594,7 @@ class ElasticTrainer:
             "fold": vmesh.fold, "grad_accum": accum,
             "drained_batches": drained, "rebuilt_program": rebuilt,
             "shard_moves": moves, "sampler_rebound": rebound,
+            "embed_moved_rows": embed_moved,
         }
 
     def _relayout_fallback(
@@ -573,6 +630,12 @@ class ElasticTrainer:
         self.step = restored_step
         self._last_saved = -1
         self._adopt_checkpoint_accum(self._ckpt.last_extra)
+        if (self._embed_plane is not None
+                and self._embed_plane.world != new_world):
+            # No embed booking rode this checkpoint — fold the live plane
+            # onto the new world directly (its rows survived in host
+            # memory; only ownership must follow the dense state).
+            self._embed_plane.reshard(new_world)
         self._rebind_sampler(new_world)
         restore_s = time.perf_counter() - t0
         detail = {
@@ -1084,6 +1147,12 @@ class ElasticTrainer:
                 self.step, self.state, StorageType.DISK,
                 extra=self._accum_extra(),
             )
+        if self._embed_plane is not None and self._embed_dir is not None:
+            # The plane's delta leg rides every dense checkpoint: rows
+            # touched since the last export land under the integrity
+            # chain, so a preemption after this point loses nothing.
+            self._embed_plane.drain(self._embed_dir, self.step)
+            self._embed_plane.emit_telemetry()
         self._last_saved = self.step
         self._dispatch("on_checkpoint", self.step)
 

@@ -133,6 +133,88 @@ def test_table_checkpoint_full_plus_delta_replay(tmp_path):
     )
 
 
+def test_native_build_retries_once_before_latching(monkeypatch, tmp_path):
+    """A transient compiler failure must not permanently demote the
+    process to the NumPy fallback: the first failed build leaves the
+    latch open, the next ``_load_native`` retries and succeeds, and only
+    two consecutive failures set ``_lib_failed``."""
+    import subprocess as real_subprocess
+
+    from dlrover_tpu.embedding import store
+
+    real_run = real_subprocess.run
+    calls = {"n": 0}
+
+    def flaky_run(cmd, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise real_subprocess.CalledProcessError(
+                1, cmd, stderr="cc1plus: out of memory"
+            )
+        return real_run(cmd, **kw)
+
+    # Fresh module state pointed at a lib path that forces a build.
+    monkeypatch.setattr(store, "_LIB", str(tmp_path / "libkvstore.so"))
+    monkeypatch.setattr(store, "_lib", None)
+    monkeypatch.setattr(store, "_lib_failed", False)
+    monkeypatch.setattr(store, "_build_attempts", 0)
+    monkeypatch.setattr(store.subprocess, "run", flaky_run)
+
+    assert store._load_native() is None      # first build fails...
+    assert store._lib_failed is False        # ...but does NOT latch
+    lib = store._load_native()               # retry rebuilds for real
+    assert lib is not None and calls["n"] == 2
+    assert store._load_native() is lib       # cached, no third build
+
+
+def test_native_build_latches_after_two_failures(monkeypatch, tmp_path):
+    import subprocess as real_subprocess
+
+    from dlrover_tpu.embedding import store
+
+    def always_fail(cmd, **kw):
+        raise real_subprocess.CalledProcessError(1, cmd, stderr="boom")
+
+    monkeypatch.setattr(store, "_LIB", str(tmp_path / "libkvstore.so"))
+    monkeypatch.setattr(store, "_lib", None)
+    monkeypatch.setattr(store, "_lib_failed", False)
+    monkeypatch.setattr(store, "_build_attempts", 0)
+    monkeypatch.setattr(store.subprocess, "run", always_fail)
+
+    assert store._load_native() is None
+    assert store._lib_failed is False
+    assert store._load_native() is None
+    assert store._lib_failed is True         # second failure latches
+    # Latched: further calls return immediately without building.
+    assert store._load_native() is None
+    # The fallback store still works under the latch.
+    fallback = KVStore(4)
+    assert fallback.native is False
+    fallback.lookup(np.array([1], np.int64), 0.1, 0, 1)
+    assert len(fallback) == 1
+
+
+def test_store_remove_deletes_keys_both_backends():
+    """Targeted deletion (the reshard migration's remove leg): removed
+    keys vanish, survivors keep their rows — including keys that shared
+    a probe chain with the victim (backward-shift correctness)."""
+    for store in stores():
+        keys = np.arange(64, dtype=np.int64)
+        before = store.lookup(keys, 0.1, 5, 1)
+        removed = store.remove(np.array([3, 9, 63, 777], np.int64))
+        assert removed == 3  # 777 was never inserted
+        assert len(store) == 61
+        np.testing.assert_array_equal(
+            store.peek(np.array([3, 9, 63], np.int64)), 0.0
+        )
+        survivors = np.array(
+            [k for k in range(64) if k not in (3, 9, 63)], np.int64
+        )
+        np.testing.assert_array_equal(
+            store.peek(survivors), before[survivors]
+        )
+
+
 def test_wide_and_deep_toy_trains_with_restart(tmp_path):
     """End-to-end recsys slice: sparse table + dense tower trained jointly;
     kill mid-run, restore both halves, loss keeps falling (the verdict's

@@ -1,0 +1,459 @@
+"""Distributed embedding plane: hash-bucketed sharding, bitwise parity
+with a single-host reference, elastic n→m resharding with optimizer
+moments intact, digest-chained export/restore, and the HBM hot-row cache
+(device parity, LRU eviction, writeback, steady-state no-retrace)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dlrover_tpu.embedding import (
+    DeviceHotRowCache,
+    EmbeddingPrefetcher,
+    ShardedEmbeddingTable,
+    hash_bucket,
+)
+from dlrover_tpu.embedding import kernels
+from dlrover_tpu.runtime.virtual_mesh import shard_owner
+from tests import trace_asserts
+
+DIM = 8
+
+
+def make_plane(world, **kw):
+    kw.setdefault("num_buckets", 16)
+    kw.setdefault("learning_rate", 0.05)
+    kw.setdefault("seed", 3)
+    return ShardedEmbeddingTable("plane", dim=DIM, world=world, **kw)
+
+
+def drive(plane, steps=4, seed=0, batch=64):
+    """Deterministic lookup+gradient stream, replayable on any fold."""
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        keys = rng.integers(0, 500, size=batch).astype(np.int64)
+        _, uniq, _ = plane.lookup(keys)
+        grads = np.outer(
+            (uniq % 13 - 6).astype(np.float32) * 0.02,
+            np.ones(DIM, np.float32),
+        )
+        plane.apply_gradients(uniq, grads)
+    return plane
+
+
+def snapshot(plane):
+    """{key: (value, m, v, count)} across every owner host."""
+    out = {}
+    for store in plane._hosts:
+        keys, rows, m, v, counts, _ = store.export()
+        for i, key in enumerate(keys.tolist()):
+            out[key] = (rows[i].copy(), m[i].copy(), v[i].copy(),
+                        int(counts[i]))
+    return out
+
+
+# -- geometry ----------------------------------------------------------------
+
+
+def test_hash_bucket_is_deterministic_and_spread():
+    keys = np.arange(10_000, dtype=np.int64)
+    a = hash_bucket(keys, 64)
+    b = hash_bucket(keys.copy(), 64)
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < 64
+    # splitmix64 must actually spread sequential ids (a modulo would not).
+    filled = np.bincount(a, minlength=64)
+    assert filled.min() > 0 and filled.max() < filled.mean() * 2
+
+
+def test_bucket_fold_agrees_with_the_virtual_mesh_rule():
+    """One ownership rule across the repo: the plane's bucket→owner map
+    IS ``shard_owner`` — the virtual mesh's fold."""
+    plane = make_plane(world=3)
+    keys = np.arange(200, dtype=np.int64)
+    buckets = plane.bucket_of(keys)
+    owners = plane.owner_of(keys)
+    for bucket, owner in zip(buckets.tolist(), owners.tolist()):
+        assert owner == shard_owner(bucket, 3)
+    for rank in range(3):
+        for bucket in plane.owned_buckets(rank):
+            assert shard_owner(bucket, 3) == rank
+    plane.close()
+
+
+def test_world_cannot_exceed_bucket_space():
+    with pytest.raises(ValueError):
+        make_plane(world=32, num_buckets=16)
+    plane = make_plane(world=2)
+    with pytest.raises(ValueError):
+        plane.reshard(17)
+    plane.close()
+
+
+# -- sharded == single host ---------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_sharded_lookup_and_update_match_single_host_bitwise(world):
+    sharded = drive(make_plane(world))
+    reference = drive(make_plane(1))
+    keys = np.arange(500, dtype=np.int64)
+    np.testing.assert_array_equal(sharded.peek(keys), reference.peek(keys))
+    assert len(sharded) == len(reference)
+    sharded.close()
+    reference.close()
+
+
+def test_lookup_returns_unique_inverse_contract():
+    plane = make_plane(2)
+    rows, uniq, inverse = plane.lookup(
+        np.array([[9, 4], [4, 9]], np.int64)
+    )
+    assert rows.shape == (2, DIM)
+    np.testing.assert_array_equal(uniq, [4, 9])
+    np.testing.assert_array_equal(inverse, [1, 0, 0, 1])
+    np.testing.assert_array_equal(rows[inverse][0], rows[inverse][3])
+    plane.close()
+
+
+# -- elastic resharding -------------------------------------------------------
+
+
+@pytest.mark.parametrize("src,dst", [
+    (1, 2), (1, 4), (2, 1), (2, 4), (4, 1), (4, 2),
+])
+def test_reshard_matrix_rows_and_moments_exact(src, dst):
+    plane = drive(make_plane(src))
+    before = snapshot(plane)
+    summary = plane.reshard(dst)
+    after = snapshot(plane)
+    assert plane.world == dst
+    assert set(before) == set(after)
+    for key in before:
+        for leg in range(3):  # value, m, v bitwise
+            np.testing.assert_array_equal(before[key][leg], after[key][leg])
+        assert before[key][3] == after[key][3]
+    # Every surviving row obeys the new fold; retired hosts are gone.
+    for rank in range(dst):
+        keys = plane._hosts[rank].export()[0]
+        np.testing.assert_array_equal(
+            plane.owner_of(keys), np.full(keys.shape, rank)
+        )
+    assert summary["src"] == src and summary["dst"] == dst
+    if src != dst:
+        assert summary["moved_rows"] > 0
+    plane.close()
+
+
+def test_reshard_then_training_still_matches_reference():
+    """The acceptance loop: train → re-fold → keep training must equal a
+    never-resharded single-host run bit for bit (plane-global clock)."""
+    elastic = drive(make_plane(4), steps=3, seed=1)
+    elastic.reshard(2)
+    drive(elastic, steps=3, seed=2)
+    reference = drive(make_plane(1), steps=3, seed=1)
+    drive(reference, steps=3, seed=2)
+    keys = np.arange(500, dtype=np.int64)
+    np.testing.assert_array_equal(
+        elastic.peek(keys), reference.peek(keys)
+    )
+    elastic.close()
+    reference.close()
+
+
+def test_reshard_with_spill_tier_moves_cold_rows(tmp_path):
+    plane = ShardedEmbeddingTable(
+        "spilled", dim=DIM, num_buckets=16, world=2, learning_rate=0.05,
+        seed=3, spill_dir=str(tmp_path),
+    )
+    drive(plane)
+    # Push everything cold so the move has to read through the disk tier.
+    for host in plane._hosts:
+        host.spill(min_step=plane.step + 1, min_count=10**6)
+    before = snapshot(plane)
+    plane.reshard(4)
+    after = snapshot(plane)
+    assert set(before) == set(after)
+    for key in before:
+        np.testing.assert_array_equal(before[key][0], after[key][0])
+        np.testing.assert_array_equal(before[key][1], after[key][1])
+    assert plane.stats()["spill_bytes"] >= 0
+    plane.close()
+
+
+# -- export / restore under the integrity chain -------------------------------
+
+
+def test_save_restore_roundtrip_with_digest_chain(tmp_path):
+    plane = drive(make_plane(2))
+    plane.save(str(tmp_path), step=4)
+    drive(plane, steps=2, seed=9)
+    plane.save(str(tmp_path), step=6, delta=True)
+
+    fresh = make_plane(2)
+    assert fresh.restore(str(tmp_path)) == plane.step
+    keys = np.arange(500, dtype=np.int64)
+    np.testing.assert_array_equal(fresh.peek(keys), plane.peek(keys))
+    assert snapshot(fresh).keys() == snapshot(plane).keys()
+    plane.close()
+    fresh.close()
+
+
+def test_restore_into_resized_world_repartitions(tmp_path):
+    """Cross-world restore: shards saved at world 4 land on a world-2
+    plane re-partitioned by the CURRENT fold — same rows, new owners."""
+    plane = drive(make_plane(4))
+    plane.save(str(tmp_path), step=4)
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))
+    assert len(fresh) == len(plane)
+    keys = np.arange(500, dtype=np.int64)
+    np.testing.assert_array_equal(fresh.peek(keys), plane.peek(keys))
+    for rank in range(2):
+        owned = fresh._hosts[rank].export()[0]
+        np.testing.assert_array_equal(
+            fresh.owner_of(owned), np.full(owned.shape, rank)
+        )
+    plane.close()
+    fresh.close()
+
+
+def test_corrupt_export_falls_back_to_previous_full(tmp_path):
+    plane = drive(make_plane(2), steps=2)
+    plane.save(str(tmp_path), step=2)
+    good = {k: v[0] for k, v in snapshot(plane).items()}
+    drive(plane, steps=2, seed=5)
+    plane.save(str(tmp_path), step=4)
+    # Corrupt the newest full export's data leg: digest must reject it.
+    newest = os.path.join(str(tmp_path), "plane_full_4")
+    victim = next(
+        os.path.join(newest, f) for f in sorted(os.listdir(newest))
+        if f.endswith(".data")
+    )
+    with open(victim, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))
+    restored = {k: v[0] for k, v in snapshot(fresh).items()}
+    assert set(restored) == set(good)
+    for key in good:
+        np.testing.assert_array_equal(restored[key], good[key])
+    plane.close()
+    fresh.close()
+
+
+def test_drain_flushes_the_delta_leg(tmp_path):
+    plane = drive(make_plane(2), steps=2)
+    plane.save(str(tmp_path), step=2)
+    drive(plane, steps=1, seed=8)
+    out = plane.drain(str(tmp_path), step=3)
+    assert "delta" in os.path.basename(out)
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))
+    keys = np.arange(500, dtype=np.int64)
+    np.testing.assert_array_equal(fresh.peek(keys), plane.peek(keys))
+    plane.close()
+    fresh.close()
+
+
+def test_booking_roundtrip_adopts_world_and_clocks():
+    plane = drive(make_plane(4))
+    booking = plane.booking()
+    assert booking["world"] == 4 and booking["num_buckets"] == 16
+    other = make_plane(2)
+    other.adopt_booking(booking)
+    assert other.world == 4
+    assert other.step == plane.step
+    mismatched = dict(booking, num_buckets=999)
+    with pytest.raises(ValueError):
+        other.adopt_booking(mismatched)
+    plane.close()
+    other.close()
+
+
+def test_stats_and_telemetry_snapshot():
+    plane = drive(make_plane(2))
+    st = plane.stats()
+    assert st["world"] == 2
+    assert st["rows_owned"] == len(plane)
+    assert st["lookups"] == 4 and st["rows_fetched"] > 0
+    plane.reshard(4)
+    st = plane.stats()
+    assert st["reshards"] == 1 and st["moved_rows"] > 0
+    assert st["reshard_s"] > 0.0
+    plane.close()
+
+
+# -- device hot-row cache -----------------------------------------------------
+
+
+def make_cache(plane, capacity=64, max_unique=32):
+    return DeviceHotRowCache(plane, capacity=capacity, max_unique=max_unique)
+
+
+def test_cache_lookup_matches_plane_bitwise():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    keys = np.array([[3, 7, 11], [7, 3, 19]], np.int64)
+    rows, uniq, inverse = cache.lookup(keys)
+    assert rows.shape == (32, DIM)
+    np.testing.assert_array_equal(
+        np.asarray(rows)[: len(uniq)], plane.peek(uniq)
+    )
+    flat_rows = np.asarray(rows)[inverse].reshape(2, 3, DIM)
+    np.testing.assert_array_equal(
+        flat_rows, plane.peek(keys).reshape(2, 3, DIM)
+    )
+    plane.close()
+
+
+def test_cache_hits_and_misses_accounted():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    cache.lookup(np.array([1, 2, 3], np.int64))
+    assert cache.misses == 3 and cache.hits == 0
+    cache.lookup(np.array([1, 2, 4], np.int64))
+    assert cache.misses == 4 and cache.hits == 2
+    assert cache.hit_rate == pytest.approx(2 / 6)
+    plane.close()
+
+
+def test_cache_evicts_lru_outside_current_batch():
+    plane = make_plane(2)
+    cache = make_cache(plane, capacity=5, max_unique=4)
+    cache.lookup(np.array([1, 2, 3, 4], np.int64))
+    cache.lookup(np.array([2, 3, 4], np.int64))  # 1 becomes LRU
+    cache.lookup(np.array([5], np.int64))        # needs one slot
+    assert cache.evictions == 1
+    assert 1 not in cache and 5 in cache
+    for key in (2, 3, 4):
+        assert key in cache
+    plane.close()
+
+
+def test_cache_writeback_after_gradients_stays_bitwise():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    keys = np.array([10, 20, 30], np.int64)
+    _, uniq, _ = cache.lookup(keys)
+    grads = np.ones((len(uniq), DIM), np.float32)
+    cache.apply_gradients(uniq, grads)
+    rows, _, _ = cache.lookup(keys)  # all hits — device copy must be fresh
+    assert cache.misses == 3
+    np.testing.assert_array_equal(
+        np.asarray(rows)[: len(uniq)], plane.peek(uniq)
+    )
+    plane.close()
+
+
+def test_cache_steady_state_does_not_retrace():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    rng = np.random.default_rng(0)
+    for _ in range(3):  # warmup: pays the two compilations
+        cache.lookup(rng.integers(0, 300, size=16).astype(np.int64))
+    with trace_asserts.assert_no_retrace("embed_gather", "embed_scatter"):
+        for _ in range(5):  # varied unique counts, same padded shapes
+            n = int(rng.integers(1, 30))
+            cache.lookup(rng.integers(0, 300, size=n).astype(np.int64))
+    plane.close()
+
+
+def test_cache_rejects_oversized_batch_and_tiny_capacity():
+    plane = make_plane(2)
+    with pytest.raises(ValueError):
+        DeviceHotRowCache(plane, capacity=8, max_unique=8)
+    cache = make_cache(plane, capacity=9, max_unique=8)
+    with pytest.raises(ValueError):
+        cache.lookup(np.arange(9, dtype=np.int64))
+    plane.close()
+
+
+def test_cache_invalidate_drops_residency():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    cache.lookup(np.array([1, 2], np.int64))
+    cache.invalidate()
+    assert len(cache) == 0
+    cache.lookup(np.array([1, 2], np.int64))
+    assert cache.misses == 4  # refetched after the invalidate
+    plane.close()
+
+
+def test_prefetcher_preserves_order_and_warms_cache():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    batches = [
+        {"ids": np.array([i, i + 100], np.int64), "tag": i}
+        for i in range(5)
+    ]
+    pf = EmbeddingPrefetcher(iter(batches), cache, depth=2)
+    seen = []
+    for batch in pf:
+        # Depth-2 prefetch keeps the NEXT batch resident before its turn.
+        assert int(batch["ids"][0]) in cache
+        seen.append(batch["tag"])
+    assert seen == [0, 1, 2, 3, 4]
+    assert cache.misses == 10  # every unique id warmed exactly once
+    plane.close()
+
+
+def test_prefetcher_drain_rewarms_after_invalidate():
+    plane = make_plane(2)
+    cache = make_cache(plane)
+    batches = [
+        {"ids": np.array([i, i + 100], np.int64)} for i in range(4)
+    ]
+    pf = EmbeddingPrefetcher(iter(batches), cache, depth=2)
+    it = iter(pf)
+    next(it)
+    # A restore/reshard under the cache: residency gone, batches kept.
+    cache.invalidate()
+    assert pf.drain() > 0
+    out = list(it)
+    assert len(out) == 3
+    assert all(int(b["ids"][0]) in cache for b in out)
+    plane.close()
+
+
+# -- kernels: pallas contract parity ------------------------------------------
+
+
+def test_kernel_modes_resolve():
+    assert kernels.kernel_mode() in ("pallas", "interpret", "jnp")
+
+
+def test_pallas_interpret_matches_jnp_contract(monkeypatch):
+    """The Pallas kernel body (run in interpreter mode on CPU) and the
+    jnp fallback are the same function: same gather, same scatter, same
+    aliasing semantics."""
+    rng = np.random.default_rng(0)
+    cache_host = rng.normal(size=(16, DIM)).astype(np.float32)
+    slots = np.array([3, 0, 7, 7, 1], np.int32)
+    # Duplicate scatter targets are only ever the scratch slot 0 carrying
+    # identical (zero) padding rows — the contract the cache guarantees.
+    scatter_slots = np.array([2, 5, 9, 0, 0], np.int32)
+    rows = rng.normal(size=(5, DIM)).astype(np.float32)
+    rows[3:] = 0.0
+
+    monkeypatch.setenv(kernels.ENV_MODE, "jnp")
+    got_jnp = np.asarray(
+        kernels.gather_rows(jnp.asarray(cache_host), slots)
+    )
+    scat_jnp = np.asarray(kernels.scatter_rows(
+        jnp.asarray(cache_host), scatter_slots, rows
+    ))
+    monkeypatch.setenv(kernels.ENV_MODE, "interpret")
+    got_pl = np.asarray(
+        kernels.gather_rows(jnp.asarray(cache_host), slots)
+    )
+    scat_pl = np.asarray(kernels.scatter_rows(
+        jnp.asarray(cache_host), scatter_slots, rows
+    ))
+    np.testing.assert_array_equal(got_jnp, cache_host[slots])
+    np.testing.assert_array_equal(got_pl, got_jnp)
+    np.testing.assert_array_equal(scat_pl, scat_jnp)

@@ -175,6 +175,65 @@ def test_replica_death_seam_fires_at_the_scripted_probe():
     assert ("replica.death", "error", 3) in faults.active().fired
 
 
+def test_embed_seams_are_known_and_plans_parse():
+    """The embedding plane's two seams speak the standard grammar: the
+    owner-exchange leg (``embed.fetch``) and the bucket-map re-fold
+    (``embed.reshard``)."""
+    for seam in ("embed.fetch", "embed.reshard"):
+        assert seam in faults.KNOWN_SEAMS
+    rules = faults.parse_plan(
+        "embed.fetch:error@2;embed.reshard:delay=0.01@every:3"
+    )
+    assert rules[0].kind == "error" and rules[0].hits == {2}
+    assert rules[1].kind == "delay" and rules[1].every == 3
+    assert faults.parse_plan("embed.fetch:error@p=0.25")[0].prob == 0.25
+
+
+def test_embed_fetch_fires_per_owner_exchange():
+    """A sharded lookup fires embed.fetch once per owner it exchanges
+    rows with — the scripted second hit is the second owner touched."""
+    import numpy as np
+
+    from dlrover_tpu.embedding import ShardedEmbeddingTable
+
+    faults.configure("embed.fetch:error@2", seed=5)
+    plane = ShardedEmbeddingTable(
+        "probe", dim=4, num_buckets=8, world=2, learning_rate=0.1, seed=1
+    )
+    # Keys spanning both owners: the second owner's exchange is hit 2.
+    keys = np.arange(32, dtype=np.int64)
+    assert len(set(plane.owner_of(keys).tolist())) == 2
+    with pytest.raises(faults.FaultInjected) as ei:
+        plane.lookup(keys)
+    assert ei.value.seam == "embed.fetch" and ei.value.hit == 2
+    assert ("embed.fetch", "error", 2) in faults.active().fired
+    plane.close()
+
+
+def test_embed_reshard_seam_aborts_before_any_owner_mutates():
+    """An injected error at embed.reshard aborts the re-fold BEFORE any
+    rows move: the plane keeps the old world and every row, so a retrying
+    caller re-enters against a consistent fold."""
+    import numpy as np
+
+    from dlrover_tpu.embedding import ShardedEmbeddingTable
+
+    plane = ShardedEmbeddingTable(
+        "probe", dim=4, num_buckets=8, world=4, learning_rate=0.1, seed=1
+    )
+    plane.lookup(np.arange(64, dtype=np.int64))
+    rows_before = len(plane)
+    faults.configure("embed.reshard:error@1", seed=5)
+    with pytest.raises(faults.FaultInjected):
+        plane.reshard(2)
+    assert plane.world == 4 and len(plane) == rows_before
+    faults.reset()
+    summary = plane.reshard(2)  # the retry lands on the intact fold
+    assert plane.world == 2 and len(plane) == rows_before
+    assert summary["moved_rows"] > 0
+    plane.close()
+
+
 @pytest.mark.parametrize("bad", [
     "storage.write",                 # no kind
     "storage.write:explode",         # unknown kind

@@ -256,6 +256,101 @@ def test_malformed_wire_events_do_not_drop_batch():
     assert [e[0] for e in timeline.events(0)[0]] == ["good", "also-good"]
 
 
+# -- embed ledger + gauges ---------------------------------------------------
+
+
+def test_embed_event_routes_through_servicer_into_gauges():
+    """An ``embed`` telemetry event lands in the speed monitor's embed
+    ledger, and the ``dlrover_embed_*`` gauges render its snapshot."""
+    sm = SpeedMonitor()
+    timeline = JobTimeline()
+    servicer = MasterServicer(speed_monitor=sm, timeline=timeline)
+    attrs = {
+        "world": 4, "rows_owned": 1200, "rows_owned_max": 400,
+        "lookups": 50, "rows_fetched": 9000, "reshards": 2,
+        "reshard_s": 0.75, "moved_rows": 300, "spill_bytes": 4096,
+        "hit_rate": 0.8, "rows_per_s": 50_000.0,
+        "unknown_future_attr": 1,  # engines may grow the event
+    }
+    wire = pickle.dumps(msg.Envelope(
+        node_id=3,
+        payload=msg.TelemetryEvents(
+            3, (("embed", "event", 0.0, 0.0, attrs),)
+        ),
+    ))
+    assert servicer.report(msg.safe_loads(wire)).success
+    ledger = sm.embed_ledger()
+    assert ledger["rows_owned"] == 1200 and ledger["reshards"] == 2
+    assert ledger["hit_rate"] == pytest.approx(0.8)
+    text = timeline.render_metrics(speed_monitor=sm)
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            metrics[key] = float(value)
+    assert metrics["dlrover_embed_rows_owned"] == 1200
+    assert metrics["dlrover_embed_rows_owned_max"] == 400
+    assert metrics["dlrover_embed_cache_hit_rate"] == pytest.approx(0.8)
+    assert metrics["dlrover_embed_lookups_total"] == 50
+    assert metrics["dlrover_embed_rows_fetched_total"] == 9000
+    assert metrics["dlrover_embed_reshards_total"] == 2
+    assert metrics["dlrover_embed_reshard_seconds_total"] == (
+        pytest.approx(0.75)
+    )
+    assert metrics["dlrover_embed_moved_rows_total"] == 300
+    assert metrics["dlrover_embed_spill_bytes"] == 4096
+    assert metrics["dlrover_embed_rows_per_s"] == 50_000
+
+
+def test_embed_ledger_newest_wins_max_aggregation_and_state():
+    """Per-node snapshots are newest-wins; the fleet aggregate takes the
+    max of plane-global counters (every reporter sees the same plane) and
+    averages the per-reporter hit rate — and the ledger round-trips
+    through the master-restart state snapshot."""
+    sm = SpeedMonitor()
+    sm.record_embed(0, rows_owned=100, hit_rate=0.5, reshards=1)
+    sm.record_embed(0, rows_owned=150, hit_rate=0.6, reshards=2)  # newest
+    sm.record_embed(1, rows_owned=149, hit_rate=0.8, reshards=2)
+    ledger = sm.embed_ledger()
+    assert ledger["reporters"] == 2 and ledger["embed_events"] == 3
+    assert ledger["rows_owned"] == 150  # max, not sum: no double count
+    assert ledger["reshards"] == 2
+    assert ledger["hit_rate"] == pytest.approx(0.7)
+    fresh = SpeedMonitor()
+    fresh.restore_embed_state(sm.embed_state())
+    assert fresh.embed_ledger() == ledger
+
+
+def test_plane_emit_telemetry_books_the_stats_snapshot():
+    """``ShardedEmbeddingTable.emit_telemetry`` books one ``embed`` event
+    whose attrs are exactly the stats the master's ledger consumes."""
+    import numpy as np
+
+    from dlrover_tpu.embedding import ShardedEmbeddingTable
+
+    r = telemetry.recorder()
+    was = r.enabled
+    r.configure(enabled=True)
+    r.drain()
+    plane = ShardedEmbeddingTable(
+        "tele", dim=4, num_buckets=8, world=2, learning_rate=0.1, seed=1
+    )
+    try:
+        plane.lookup(np.arange(16, dtype=np.int64))
+        plane.emit_telemetry(hit_rate=0.9)
+        events = [e for e in r.drain() if e[0] == "embed"]
+        assert len(events) == 1
+        attrs = events[0][4]
+        assert attrs["world"] == 2 and attrs["rows_owned"] == 16
+        assert attrs["lookups"] == 1 and attrs["hit_rate"] == 0.9
+        sm = SpeedMonitor()
+        sm.record_embed(0, **attrs)  # the servicer's exact call shape
+        assert sm.embed_ledger()["rows_owned"] == 16
+    finally:
+        plane.close()
+        r.configure(enabled=was)
+
+
 # -- chrome trace ------------------------------------------------------------
 
 

@@ -209,6 +209,124 @@ def test_serve_fleet_gate_predicate():
     assert not ok and failed == ["p95_recovered_under_slo"]
 
 
+def test_embed_bench_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "embed_bench.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--out" in out.stdout and "--num-buckets" in out.stdout
+    assert "--bench-steps" in out.stdout and "--world" in out.stdout
+    assert "--cache-rows" in out.stdout and "--max-unique" in out.stdout
+
+
+def test_embed_bench_gate_predicate():
+    """The EMBED.json ok gate is a pure predicate: every embedding-plane
+    invariant is a named check that fails individually."""
+    tool = _load_module(
+        os.path.join(REPO, "tools", "embed_bench.py"), "_embed_bench"
+    )
+
+    def leg(src, dst):
+        return {
+            "src": src, "dst": dst, "rows": 100, "moved_rows": 40,
+            "reshard_s": 0.01, "row_exact": True, "moments_equal": True,
+            "ownership_ok": True,
+        }
+
+    result = {
+        "parity": {"bitwise_equal": True, "rows_checked": 2848},
+        "reshard": {"matrix": [
+            leg(s, d) for s in (1, 2, 4) for d in (1, 2, 4) if s != d
+        ]},
+        "hot_path": {"gather_retraces": 0, "scatter_retraces": 0},
+        "throughput": {"hit_rate": 0.5, "rows_per_s": 60_000.0},
+    }
+    ok, failed = tool.evaluate_embed_gate(result)
+    assert ok and failed == []
+
+    drifted = dict(result, parity={"bitwise_equal": False,
+                                   "rows_checked": 2848})
+    ok, failed = tool.evaluate_embed_gate(drifted)
+    assert not ok and failed == ["sharded_parity_bitwise"]
+
+    lossy_leg = dict(leg(2, 4), row_exact=False, moments_equal=False)
+    lossy = dict(result, reshard={"matrix": (
+        result["reshard"]["matrix"][:5] + [lossy_leg]
+    )})
+    ok, failed = tool.evaluate_embed_gate(lossy)
+    assert not ok
+    assert "reshard_all_row_exact" in failed
+    assert "reshard_moments_intact" in failed
+
+    partial_matrix = dict(
+        result, reshard={"matrix": result["reshard"]["matrix"][:5]}
+    )
+    ok, failed = tool.evaluate_embed_gate(partial_matrix)
+    assert not ok and failed == ["reshard_matrix_covered"]
+
+    retraced = dict(result, hot_path={"gather_retraces": 2,
+                                      "scatter_retraces": 0})
+    ok, failed = tool.evaluate_embed_gate(retraced)
+    assert not ok and failed == ["steady_state_no_retrace"]
+
+    cold = dict(result, throughput={"hit_rate": 0.0, "rows_per_s": 0.0})
+    ok, failed = tool.evaluate_embed_gate(cold)
+    assert not ok
+    assert failed == ["cache_hits_happen", "rows_served"]
+
+
+def test_train_rec_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_rec.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--num-buckets" in out.stdout and "--world" in out.stdout
+    assert "--cache-rows" in out.stdout and "--max-unique" in out.stdout
+    assert "--prefetch-depth" in out.stdout
+    assert "--reshard-at" in out.stdout
+    assert "--sparse-optimizer" in out.stdout
+
+
+def test_train_wide_deep_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "train_wide_deep.py"), "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--id-space" in out.stdout and "--dim" in out.stdout
+    assert "--sparse-optimizer" in out.stdout
+    assert "--evict-every" in out.stdout
+
+
+@pytest.mark.slow
+def test_train_rec_short_e2e(tmp_path, monkeypatch, capfd):
+    """A tiny real train_rec run: trains, reshards mid-run, checkpoints
+    the sharded plane, and exits 0 (standalone mode, CPU)."""
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    try:
+        import train_rec
+    finally:
+        sys.path.pop(0)
+    ckpt = tmp_path / "rec_ckpt"
+    monkeypatch.setattr(sys, "argv", [
+        "train_rec.py", "--steps", "6", "--batch-size", "16",
+        "--fields", "4", "--id-space", "500", "--dim", "8",
+        "--hidden", "16", "--world", "2", "--num-buckets", "8",
+        "--cache-rows", "128", "--max-unique", "64",
+        "--reshard-at", "3:1", "--checkpoint-dir", str(ckpt),
+        "--ckpt-every", "4",
+    ])
+    assert train_rec.main() == 0
+    err = capfd.readouterr().err
+    assert "resharded 2 -> 1 owners at step 3" in err
+    assert os.listdir(ckpt), "plane checkpoint must land on disk"
+
+
 def test_metrics_scrape_help(cpu_child_env):
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "metrics_scrape.py"),

@@ -692,6 +692,29 @@ def test_seam001_ignores_cold_tiers(tmp_path):
     assert report.findings == []
 
 
+def test_seam001_covers_the_embedding_tier(tmp_path):
+    """embedding/ is a fault tier: its spill logs and table exports are
+    remote-storage-shaped I/O, so raw open/replace without a registered
+    seam in scope is a finding — and the embed seams count as coverage."""
+    (tmp_path / "embedding").mkdir()
+    report = lint(
+        tmp_path, os.path.join("embedding", "m.py"),
+        SEAM001_BAD, select=["SEAM001"],
+    )
+    assert rule_ids(report) == ["SEAM001"]
+    covered = SEAM001_BAD.replace(
+        "def persist(path, blob):",
+        "from dlrover_tpu.common import faults\n"
+        "def persist(path, blob):\n"
+        '    faults.fire("embed.reshard", src=2, dst=4)',
+    )
+    report = lint(
+        tmp_path, os.path.join("embedding", "m2.py"),
+        covered, select=["SEAM001"],
+    )
+    assert report.findings == []
+
+
 SEAM001_READ_BAD = """\
 def load(path):
     with open(path) as fh:

@@ -300,7 +300,7 @@ class ShardedEmbeddingTable:
                     self._hosts.append(self._make_store(len(self._hosts)))
                 for src in range(old_world):
                     moved_rows, moved_bytes = self._migrate_from(
-                        src, old_world, new_world, moved_rows, moved_bytes,
+                        src, new_world, moved_rows, moved_bytes,
                     )
                 for rank in range(new_world, len(self._hosts)):
                     leftover = len(self._hosts[rank])
@@ -331,24 +331,30 @@ class ShardedEmbeddingTable:
                 "moved_rows": moved_rows, "moved_bytes": moved_bytes,
             }
 
-    def _migrate_from(self, src: int, old_world: int, new_world: int,
+    def _migrate_from(self, src: int, new_world: int,
                       moved_rows: int, moved_bytes: int):
         """Move ``src``'s rows whose bucket re-folded elsewhere.  Rows are
         packed in the spill-log record format, inserted at the new owner
         (moments and freshness metadata intact), then removed at the
         source — insert-before-remove, so an interruption duplicates
-        instead of losing (the bucket map decides which copy serves)."""
+        instead of losing (the bucket map decides which copy serves).
+
+        A row moves iff its NEW owner differs from the host that holds it
+        NOW.  Comparing old fold vs new fold instead would, on folds where
+        neither world divides the other (3→2, 2→3, 4→6), re-select a row
+        already migrated INTO a later-processed source with destination ==
+        itself — insert into the same store, then remove: the row is lost.
+        """
         store = self._hosts[src]
         all_keys, rows, m, v, counts, steps = store.export()
         if all_keys.size == 0:
             return moved_rows, moved_bytes
-        buckets = self.bucket_of(all_keys)
-        sel_move = (buckets % old_world) != (buckets % new_world)
+        dsts = self.bucket_of(all_keys) % new_world
+        sel_move = dsts != src
         if not sel_move.any():
             return moved_rows, moved_bytes
-        dsts = buckets % new_world
         for dst in np.unique(dsts[sel_move]):
-            sel = sel_move & (dsts == dst)
+            sel = dsts == dst
             payload = spill_mod.pack_records(
                 all_keys[sel], rows[sel], m[sel], v[sel],
                 counts[sel], steps[sel],
@@ -383,7 +389,6 @@ class ShardedEmbeddingTable:
         kind = "delta" if delta else "full"
         out_dir = self._export_dir(directory, kind, step)
         min_step = self._last_export_step if delta else 0
-        self._last_export_step = self.step + 1
         os.makedirs(out_dir, exist_ok=True)
         for rank, store in enumerate(self._hosts):
             keys, rows, m, v, counts, steps = store.export(min_step)
@@ -410,6 +415,10 @@ class ShardedEmbeddingTable:
                 ))
             for ext in (".meta", ".data", ".digest"):
                 os.replace(base + ext + ".tmp", base + ext)
+        # Commit the delta watermark only once EVERY shard is in place: a
+        # failed partial export must leave the next delta covering the
+        # same rows, or the preemption drain silently drops them.
+        self._last_export_step = self.step + 1
         logger.info(
             "embedding plane %s: saved %s export (%d hosts, %d rows) to %s",
             self.name, kind, self.world, len(self), out_dir,
@@ -461,13 +470,19 @@ class ShardedEmbeddingTable:
 
     def _load_export(self, export_dir: str) -> int:
         """Insert one export's rows, re-partitioned under the CURRENT
-        fold — cross-world restore is the same path as same-world."""
+        fold — cross-world restore is the same path as same-world.
+
+        Two-pass, so the export is all-or-nothing: pass 1 digest-verifies
+        EVERY shard (and that the rank set is complete) before pass 2
+        inserts a single row.  A corrupt/torn shard therefore raises with
+        the plane untouched, and ``restore``'s fall-back never mixes rows
+        from two checkpoints."""
         shards = sorted(
             fname[: -len(".meta")]
             for fname in os.listdir(export_dir)
             if fname.endswith(".meta")
         )
-        loaded = 0
+        verified = []
         for shard in shards:
             meta, arrays = self._read_shard(os.path.join(export_dir, shard))
             if meta["dim"] != self.dim:
@@ -481,6 +496,16 @@ class ShardedEmbeddingTable:
                     f"{self.num_buckets} — the logical bucket space is "
                     "fixed for the table's lifetime"
                 )
+            verified.append((meta, arrays))
+        ranks = sorted(meta["rank"] for meta, _ in verified)
+        want = list(range(verified[0][0]["world"])) if verified else []
+        if not verified or ranks != want:
+            raise ValueError(
+                f"embedding export {export_dir}: torn export — have "
+                f"shards for ranks {ranks}, expected {want or 'some'}"
+            )
+        loaded = 0
+        for meta, arrays in verified:
             keys = arrays["keys"]
             if keys.size == 0:
                 continue
@@ -521,7 +546,17 @@ class ShardedEmbeddingTable:
             return 0
         for step, kind, path in sorted(exports):
             if kind == "delta" and step > base_step:
-                self._load_export(path)
+                try:
+                    self._load_export(path)
+                except (ValueError, OSError) as e:
+                    # Same reject-and-continue discipline as the full leg:
+                    # a corrupt/torn delta loses its window's updates but
+                    # never aborts the restore or half-applies its rows.
+                    logger.warning(
+                        "embedding plane %s: rejecting delta export %s "
+                        "(%s); continuing with the remaining exports",
+                        self.name, path, e,
+                    )
         self._last_export_step = self.step + 1
         logger.info(
             "embedding plane %s: restored %d rows across %d hosts",

@@ -10,6 +10,7 @@ import pytest
 
 import jax.numpy as jnp
 
+from dlrover_tpu.common import faults
 from dlrover_tpu.embedding import (
     DeviceHotRowCache,
     EmbeddingPrefetcher,
@@ -124,6 +125,11 @@ def test_lookup_returns_unique_inverse_contract():
 
 @pytest.mark.parametrize("src,dst", [
     (1, 2), (1, 4), (2, 1), (2, 4), (4, 1), (4, 2),
+    # Non-divisor folds: a bucket's owner can change to a host that is
+    # ALSO a migration source (e.g. 3→2: bucket 3 moves host 0 → host 1
+    # while host 1 is still pending).  Selecting movers by old-fold vs
+    # new-fold instead of new-owner vs current-host loses those rows.
+    (3, 2), (2, 3), (4, 3), (3, 4), (4, 6), (6, 4),
 ])
 def test_reshard_matrix_rows_and_moments_exact(src, dst):
     plane = drive(make_plane(src))
@@ -145,6 +151,24 @@ def test_reshard_matrix_rows_and_moments_exact(src, dst):
     assert summary["src"] == src and summary["dst"] == dst
     if src != dst:
         assert summary["moved_rows"] > 0
+    plane.close()
+
+
+def test_reshard_non_divisor_chain_is_lossless():
+    """3→2→3 round trip over a dense population: every row and moment
+    survives both non-divisor folds bitwise (regression for the
+    migrated-row-re-selected-at-a-later-source row-loss bug)."""
+    plane = drive(make_plane(3), steps=8, batch=256)
+    before = snapshot(plane)
+    assert len(before) > 400  # dense enough to populate every bucket pair
+    plane.reshard(2)
+    assert len(plane) == len(before)
+    plane.reshard(3)
+    after = snapshot(plane)
+    assert set(before) == set(after)
+    for key in before:
+        for leg in range(3):
+            np.testing.assert_array_equal(before[key][leg], after[key][leg])
     plane.close()
 
 
@@ -242,6 +266,107 @@ def test_corrupt_export_falls_back_to_previous_full(tmp_path):
     assert set(restored) == set(good)
     for key in good:
         np.testing.assert_array_equal(restored[key], good[key])
+    plane.close()
+    fresh.close()
+
+
+def test_corrupt_late_shard_never_mixes_two_checkpoints(tmp_path):
+    """A digest mismatch on the LAST shard must reject the whole export
+    before any row lands: restore is two-pass (verify all, then insert),
+    so the fallback full is the only checkpoint the plane ever holds."""
+    plane = drive(make_plane(2), steps=2)
+    plane.save(str(tmp_path), step=2)
+    good = snapshot(plane)
+    drive(plane, steps=2, seed=5)
+    plane.save(str(tmp_path), step=4)
+    newest = os.path.join(str(tmp_path), "plane_full_4")
+    victim = [
+        os.path.join(newest, f) for f in sorted(os.listdir(newest))
+        if f.endswith(".data")
+    ][-1]  # the LAST shard read — earlier shards verify clean
+    with open(victim, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))
+    restored = snapshot(fresh)
+    assert set(restored) == set(good)
+    for key in good:
+        np.testing.assert_array_equal(restored[key][0], good[key][0])
+        np.testing.assert_array_equal(restored[key][1], good[key][1])
+    plane.close()
+    fresh.close()
+
+
+def test_torn_export_missing_shard_falls_back(tmp_path):
+    """An export missing a host shard (interrupted save) is rejected for
+    the previous full — rank completeness is part of verification."""
+    plane = drive(make_plane(2), steps=2)
+    plane.save(str(tmp_path), step=2)
+    good = {k: v[0] for k, v in snapshot(plane).items()}
+    drive(plane, steps=2, seed=5)
+    plane.save(str(tmp_path), step=4)
+    newest = os.path.join(str(tmp_path), "plane_full_4")
+    for fname in os.listdir(newest):
+        if fname.startswith("host_1_"):
+            os.remove(os.path.join(newest, fname))
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))
+    restored = {k: v[0] for k, v in snapshot(fresh).items()}
+    assert set(restored) == set(good)
+    for key in good:
+        np.testing.assert_array_equal(restored[key], good[key])
+    plane.close()
+    fresh.close()
+
+
+def test_corrupt_delta_is_rejected_and_restore_continues(tmp_path):
+    """A corrupt delta export loses its window but must not abort the
+    restore or half-apply: the full export's state survives intact."""
+    plane = drive(make_plane(2), steps=2)
+    plane.save(str(tmp_path), step=2)
+    good = snapshot(plane)
+    drive(plane, steps=1, seed=8)
+    plane.save(str(tmp_path), step=3, delta=True)
+    delta_dir = os.path.join(str(tmp_path), "plane_delta_3")
+    victim = next(
+        os.path.join(delta_dir, f) for f in sorted(os.listdir(delta_dir))
+        if f.endswith(".data")
+    )
+    with open(victim, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))  # must not raise
+    restored = snapshot(fresh)
+    assert set(restored) == set(good)
+    for key in good:
+        np.testing.assert_array_equal(restored[key][0], good[key][0])
+    plane.close()
+    fresh.close()
+
+
+def test_failed_save_keeps_the_delta_watermark(tmp_path):
+    """A save that dies partway (storage.write fault) must not advance
+    ``_last_export_step``: the next drain still covers every row touched
+    since the last SUCCESSFUL export — the preemption-drain guarantee."""
+    plane = drive(make_plane(2), steps=2)
+    plane.save(str(tmp_path), step=2)
+    watermark = plane._last_export_step
+    drive(plane, steps=1, seed=8)
+    faults.configure("storage.write:error@1")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            plane.save(str(tmp_path), step=3, delta=True)
+    finally:
+        faults.reset()
+    assert plane._last_export_step == watermark
+    out = plane.drain(str(tmp_path), step=4)
+    assert "delta" in os.path.basename(out)
+    fresh = make_plane(2)
+    fresh.restore(str(tmp_path))
+    keys = np.arange(500, dtype=np.int64)
+    np.testing.assert_array_equal(fresh.peek(keys), plane.peek(keys))
     plane.close()
     fresh.close()
 

@@ -238,7 +238,8 @@ def test_embed_bench_gate_predicate():
     result = {
         "parity": {"bitwise_equal": True, "rows_checked": 2848},
         "reshard": {"matrix": [
-            leg(s, d) for s in (1, 2, 4) for d in (1, 2, 4) if s != d
+            leg(s, d) for s in (1, 2, 3, 4) for d in (1, 2, 3, 4)
+            if s != d
         ]},
         "hot_path": {"gather_retraces": 0, "scatter_retraces": 0},
         "throughput": {"hit_rate": 0.5, "rows_per_s": 60_000.0},
@@ -253,7 +254,7 @@ def test_embed_bench_gate_predicate():
 
     lossy_leg = dict(leg(2, 4), row_exact=False, moments_equal=False)
     lossy = dict(result, reshard={"matrix": (
-        result["reshard"]["matrix"][:5] + [lossy_leg]
+        result["reshard"]["matrix"][:11] + [lossy_leg]
     )})
     ok, failed = tool.evaluate_embed_gate(lossy)
     assert not ok
@@ -261,7 +262,7 @@ def test_embed_bench_gate_predicate():
     assert "reshard_moments_intact" in failed
 
     partial_matrix = dict(
-        result, reshard={"matrix": result["reshard"]["matrix"][:5]}
+        result, reshard={"matrix": result["reshard"]["matrix"][:11]}
     )
     ok, failed = tool.evaluate_embed_gate(partial_matrix)
     assert not ok and failed == ["reshard_matrix_covered"]

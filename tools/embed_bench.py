@@ -6,9 +6,12 @@ Four phases, one verdict (``EMBED.json``):
    world-N sharded plane and a single-host reference plane; every touched
    row must match BITWISE (deterministic per-key init + a plane-global
    optimizer clock make sharding invisible to the math).
-2. **reshard matrix** — every n→m fold over worlds {1, 2, 4}: rows,
+2. **reshard matrix** — every n→m fold over worlds {1, 2, 3, 4}: rows,
    optimizer moments, and counts must survive the owner-to-owner move
-   exactly, and every surviving row must land on ``bucket % m``.
+   exactly, and every surviving row must land on ``bucket % m``.  The
+   matrix deliberately includes non-divisor folds (3→2, 2→3, 3→4, 4→3):
+   those are the pairs where selecting rows by old-fold-vs-new-fold
+   instead of new-owner-vs-current-host silently loses rows.
 3. **no-retrace** — steady-state device-cache lookups over varied key
    sets must not retrace the jitted gather/scatter (fixed padded shapes);
    pinned via ``train_lib.trace_count``.
@@ -68,7 +71,7 @@ def evaluate_embed_gate(result):
         "reshard_ownership_folds": all(
             leg["ownership_ok"] for leg in result["reshard"]["matrix"]
         ),
-        "reshard_matrix_covered": len(result["reshard"]["matrix"]) >= 6,
+        "reshard_matrix_covered": len(result["reshard"]["matrix"]) >= 12,
         "steady_state_no_retrace": (
             result["hot_path"]["gather_retraces"] == 0
             and result["hot_path"]["scatter_retraces"] == 0
@@ -152,7 +155,7 @@ def _snapshot(plane):
 def run_reshard_matrix(args):
     import numpy as np
 
-    worlds = (1, 2, 4)
+    worlds = (1, 2, 3, 4)  # 3 makes the non-divisor folds real
     matrix = []
     for src in worlds:
         for dst in worlds:

@@ -107,6 +107,32 @@ def recompute_flops_per_token(config, remat: str) -> float:
 
 PROBE_TIMEOUT_S = 180
 PROBE_ATTEMPTS = 2
+# Overall probe budget: attempts + backoffs must finish inside this, so a
+# wedged relay (BENCH_r05: "backend init exceeded 180s") costs a bounded,
+# known amount of the sweep's wall clock — never attempts x timeout x
+# unbounded sleeps.
+PROBE_DEADLINE_S = 420.0
+
+
+class _ProbeFailed(Exception):
+    """One failed backend-probe attempt (cause string in args[0])."""
+
+
+def _probe_attempt() -> None:
+    """One killable-child probe attempt; raises :class:`_ProbeFailed`."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(len(d), d[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        raise _ProbeFailed(
+            f"backend init exceeded {PROBE_TIMEOUT_S}s (device relay hang)"
+        ) from None
+    if out.returncode != 0:
+        raise _ProbeFailed((out.stderr or out.stdout).strip()[-2000:])
 
 
 def _probe_backend() -> "str | None":
@@ -116,34 +142,31 @@ def _probe_backend() -> "str | None":
     forever (no exception to catch) — probing in a killable child is the
     only way to bound it.  Returns None when healthy, else the cause
     string; the child exits before this process initializes its own
-    backend, so a healthy chip is never double-claimed.
+    backend, so a healthy chip is never double-claimed.  Attempts ride
+    the shared :class:`~dlrover_tpu.common.retry.RetryPolicy` (jittered
+    backoff + an overall deadline) instead of a hand-rolled loop.
     """
     from dlrover_tpu.common import faults
+    from dlrover_tpu.common.retry import RetryError, RetryPolicy
 
     try:
         faults.fire("backend.init")
     except faults.FaultInjected as e:
         return f"backend init fault injected: {e}"
-    err = "unknown"
-    for attempt in range(PROBE_ATTEMPTS):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "print(len(d), d[0].platform)"],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-            )
-            if out.returncode == 0:
-                return None
-            err = (out.stderr or out.stdout).strip()[-2000:]
-        except subprocess.TimeoutExpired:
-            err = (
-                f"backend init exceeded {PROBE_TIMEOUT_S}s "
-                "(device relay hang)"
-            )
-        if attempt + 1 < PROBE_ATTEMPTS:
-            time.sleep(20)
-    return err
+    policy = RetryPolicy(
+        max_attempts=PROBE_ATTEMPTS,
+        base_delay_s=10.0,
+        max_delay_s=30.0,
+        deadline_s=PROBE_DEADLINE_S,
+        retryable=(_ProbeFailed,),
+        name="bench.backend_probe",
+    )
+    try:
+        policy.call(_probe_attempt)
+        return None
+    except RetryError as e:
+        last = e.last_error
+        return str(last.args[0] if last.args else last)[:2000]
 
 
 # CPU-fallback shape: small enough for a few-second run on a host core,
@@ -182,7 +205,7 @@ def _ensure_cpu(cause: str) -> None:
 def _cpu_fallback_bench(cause: str, entry: str = "baseline",
                         grad_accum: int = 1,
                         reduce_quant: str = "none",
-                        zero1: bool = False,
+                        zero1: bool = False, overlap: bool = False,
                         scaling: "dict | None" = None) -> None:
     """Relative CPU-mesh metric when the TPU backend is wedged.
 
@@ -219,6 +242,7 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
         model, opt, mesh, lr.DEFAULT_RULES,
         global_batch_size=global_batch, seq_len=CPU_FALLBACK_SEQ,
         grad_accum=grad_accum, reduce_quant=reduce_quant, zero1=zero1,
+        overlap=overlap,
     )
     state = train.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
@@ -288,11 +312,13 @@ BENCH_ENTRIES = (
     ("baseline", {"grad_accum": 1, "reduce_quant": "none"}),
     ("grad_accum=4", {"grad_accum": 4, "reduce_quant": "none"}),
     ("zero1", {"grad_accum": 4, "reduce_quant": "none", "zero1": True}),
+    ("zero1+overlap", {"grad_accum": 4, "reduce_quant": "none",
+                       "zero1": True, "overlap": True}),
 )
 
 
 def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
-               zero1: bool = False,
+               zero1: bool = False, overlap: bool = False,
                scaling: "dict | None" = None) -> None:
     from dlrover_tpu.auto import est_comm_time, pick_grad_accum
     from dlrover_tpu.models.gpt2 import gpt2_config
@@ -398,6 +424,15 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
         })
     if zero1:
         detail["zero1"] = bool(train.zero1)
+        if overlap:
+            # The overlap engine's bucket plan + the overlap-aware comm
+            # pricing next to the measurement (PROFILE.md round 16).
+            detail["overlap"] = bool(train.overlap)
+            detail["overlap_plan"] = train.overlap_plan
+            detail["est_comm_s_overlap"] = round(
+                est_comm_time(config, parallel, reduce_quant,
+                              overlap=True, grad_accum=grad_accum), 6
+            )
         if train.zero1_stats:
             # The sharded-update memory story (opt-state MB/device before
             # vs after the data-axis split) — PROFILE.md's memory model.
@@ -439,6 +474,19 @@ def main(argv=None) -> int:
     # PROBE_ATTEMPTS x PROBE_TIMEOUT_S once, and every entry reuses the
     # verdict (VERDICT top_next: no second 180 s hang).
     cause = _probe_backend()
+    rc = 0
+    if cause is not None:
+        # Probe exhausted its RetryPolicy budget: emit one structured
+        # failure line and fail the sweep's rc so CI surfaces the outage
+        # even though the CPU-mesh fallback entries below still run.
+        print(json.dumps({
+            "ok": False,
+            "stage": "backend-probe",
+            "cause": cause[:2000],
+            "attempts": PROBE_ATTEMPTS,
+            "deadline_s": PROBE_DEADLINE_S,
+        }), flush=True)
+        rc = 1
     # The 1->n scaling curve is measured ONCE and attached to every
     # entry's JSON (the curve is a property of the sweep's backend, not of
     # any single knob).  measure_scaling does its own virtual-CPU
@@ -455,7 +503,6 @@ def main(argv=None) -> int:
             scaling = measure_scaling((1, 2, 4, 8))
         except Exception as e:  # noqa: BLE001 — curve is additive, not load-bearing
             scaling = {"ok": False, "cause": f"{type(e).__name__}: {e}"}
-    rc = 0
     for entry, knobs in entries:
         try:
             if cause is not None:

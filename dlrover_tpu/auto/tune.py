@@ -543,12 +543,27 @@ def pick_grad_accum(
     return feasible[-1]
 
 
+# Default hidden share of the overlapped collective legs: the estimator's
+# prior until a profiler capture books a *measured* overlap fraction into
+# the calibration ledger (utils/device_profile.py -> master/calibration.py),
+# at which point est_comm_time prices with the measured number instead.
+OVERLAP_HIDDEN_DEFAULT = 0.7
+# Per-bucket collective launch overhead (descriptor setup + barrier);
+# what stops bucket_mb -> 0 from looking free in the estimate.
+BUCKET_LAUNCH_S = 5e-6
+
+
 def est_comm_time(
     config: TransformerConfig,
     parallel: ParallelConfig,
     reduce_quant: str = "none",
+    *,
+    overlap: bool = False,
+    bucket_mb: float = 0.0,
+    grad_accum: int = 1,
+    calibration=None,
 ) -> float:
-    """Seconds for the once-per-step data-parallel gradient reduce.
+    """Seconds of *exposed* wire for the data-parallel gradient reduce.
 
     Modeled as its actual lowering — a reduce-scatter leg plus an
     all-gather leg, each moving ``n·2/shard·(dp-1)/dp`` bytes over ICI
@@ -560,6 +575,19 @@ def est_comm_time(
     under ZeRO-1 the updated *params* riding back — stays full precision;
     the quantize/dequantize passes add ~2 HBM sweeps over the sharded
     gradient tree.  Zero when data=1: there is no reduce to price.
+
+    ``overlap=True`` prices the overlap engine's schedule
+    (``parallel/overlap.py``): the reduce-scatter runs once per
+    microbatch (``grad_accum``× the leg bytes on the wire) but a
+    ``hidden`` fraction of each leg rides under backward/forward compute,
+    so only the exposed remainder enters the step's critical path — plus
+    a fill/drain of one bucket at each end of the pipeline (the first
+    bucket has no compute ahead of it, the last none behind) and a
+    per-bucket launch overhead that keeps tiny buckets from looking
+    free.  ``hidden`` starts at :data:`OVERLAP_HIDDEN_DEFAULT` and is
+    replaced by the calibration ledger's *measured* overlap fraction
+    (``ledger.overlap()``) as soon as profiler captures book one — the
+    exposed-vs-hidden split is learned, not assumed.
     """
     _, hbm_bw, _, ici_bw = chip_specs()
     p = parallel
@@ -569,12 +597,36 @@ def est_comm_time(
     shard = p.fsdp * p.tensor * p.pipe * max(p.expert, 1)
     leg_b = n * 2 / shard * (p.data - 1) / p.data
     if reduce_quant == "int8":
-        return (
-            leg_b / 3.5 / ici_bw          # quantized reduce-scatter leg
-            + leg_b / ici_bw              # full-precision gather leg
-            + 2 * (n * 2 / shard) / hbm_bw  # quantize/dequantize sweeps
-        )
-    return 2 * leg_b / ici_bw
+        rs_t = leg_b / 3.5 / ici_bw       # quantized reduce-scatter leg
+        sweep_t = 2 * (n * 2 / shard) / hbm_bw  # quant/dequant sweeps
+    else:
+        rs_t = leg_b / ici_bw
+        sweep_t = 0.0
+    ag_t = leg_b / ici_bw                 # full-precision gather leg
+    if not overlap:
+        return rs_t + ag_t + sweep_t
+    hidden = OVERLAP_HIDDEN_DEFAULT
+    if calibration is not None:
+        measured = getattr(calibration, "overlap", lambda: 0.0)()
+        if measured > 0.0:
+            hidden = min(float(measured), 0.95)
+    accum = max(1, grad_accum)
+    # Per-microbatch reduce-scatter: accum x the wire, (1 - hidden) of it
+    # exposed.  The quant/dequant sweeps run per microbatch too, and HBM
+    # sweeps contend with compute's own HBM traffic — kept fully exposed.
+    rs_exposed = rs_t * accum * (1.0 - hidden)
+    ag_exposed = ag_t * (1.0 - hidden)
+    total_b = (n * 2 / shard) * (accum + 1)   # RS waves + AG wave
+    if bucket_mb > 0:
+        n_buckets = max(1, math.ceil(total_b / (bucket_mb * 1e6)))
+        fill_drain = 2 * (bucket_mb * 1e6) / ici_bw
+    else:
+        n_buckets = accum + 1                 # one wave per collective
+        fill_drain = rs_t + ag_t              # nothing pipelines
+    return (
+        rs_exposed + ag_exposed + sweep_t * accum
+        + fill_drain + n_buckets * BUCKET_LAUNCH_S
+    )
 
 
 def _measure(

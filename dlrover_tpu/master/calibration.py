@@ -47,6 +47,11 @@ class CalibrationLedger:
         # lets the first observation seed the EWMA instead of decaying
         # toward an arbitrary prior).
         self._counts: Dict[str, Dict[str, int]] = {}
+        # cache_key -> {"fraction": EWMA overlap fraction, "count": n}.
+        # Separate from _ratios: overlap is a [0,1] fraction of measured
+        # collective seconds hidden under compute, not a measured/modeled
+        # ratio.
+        self._overlap: Dict[str, Dict[str, float]] = {}
 
     def observe(
         self, cache_key: str, phase: str, measured: float, modeled: float
@@ -66,6 +71,41 @@ class CalibrationLedger:
             else:
                 per_key[phase] = ratio
             counts[phase] = counts.get(phase, 0) + 1
+
+    def observe_overlap(self, cache_key: str, fraction: float):
+        """Fold one *measured* collective-overlap fraction in (the share
+        of device collective seconds that ran concurrently with compute,
+        ``utils/device_profile.DeviceWindow.overlap_fraction``).  Values
+        outside [0, 1] carry no signal and are skipped."""
+        if not 0.0 <= fraction <= 1.0:
+            return
+        key = cache_key or "uncacheable"
+        with self._lock:
+            per_key = self._overlap.setdefault(key, {})
+            if "fraction" in per_key:
+                per_key["fraction"] += self.alpha * (
+                    fraction - per_key["fraction"]
+                )
+            else:
+                per_key["fraction"] = fraction
+            per_key["count"] = per_key.get("count", 0.0) + 1.0
+
+    def overlap(self, cache_key: Optional[str] = None) -> float:
+        """Measured collective-overlap fraction EWMA.
+
+        With ``cache_key``: that program's fraction (0.0 when never
+        observed).  Without: the mean over all observed keys — what
+        ``auto/tune.est_comm_time`` uses as the learned hidden share and
+        the ``dlrover_overlap_fraction`` gauge renders."""
+        with self._lock:
+            if cache_key is not None:
+                per_key = self._overlap.get(cache_key or "uncacheable", {})
+                return float(per_key.get("fraction", 0.0))
+            fracs = [
+                v["fraction"] for v in self._overlap.values()
+                if "fraction" in v
+            ]
+            return sum(fracs) / len(fracs) if fracs else 0.0
 
     def ratios(self, cache_key: Optional[str] = None) -> Dict[str, float]:
         """Per-phase-kind correction factors.
@@ -90,7 +130,7 @@ class CalibrationLedger:
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._ratios)
+            return len(set(self._ratios) | set(self._overlap))
 
     # -- state snapshot ------------------------------------------------------
 
@@ -101,6 +141,7 @@ class CalibrationLedger:
                 "alpha": self.alpha,
                 "ratios": {k: dict(v) for k, v in self._ratios.items()},
                 "counts": {k: dict(v) for k, v in self._counts.items()},
+                "overlap": {k: dict(v) for k, v in self._overlap.items()},
             }
 
     def restore(self, state: Dict):
@@ -115,4 +156,8 @@ class CalibrationLedger:
             self._counts = {
                 str(k): {str(p): int(c) for p, c in v.items()}
                 for k, v in state.get("counts", {}).items()
+            }
+            self._overlap = {
+                str(k): {str(p): float(r) for p, r in v.items()}
+                for k, v in state.get("overlap", {}).items()
             }

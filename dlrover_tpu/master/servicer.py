@@ -393,6 +393,19 @@ class MasterServicer:
                             "unparseable calibration event from %d: %r",
                             node, attrs,
                         )
+                if "overlap" in attrs:
+                    # Measured collective-overlap fraction from the same
+                    # window — feeds est_comm_time's learned hidden share
+                    # and the dlrover_overlap_fraction gauge.
+                    try:
+                        self.calibration.observe_overlap(
+                            key, float(attrs["overlap"])
+                        )
+                    except (TypeError, ValueError):
+                        logger.warning(
+                            "unparseable overlap attr from %d: %r",
+                            node, attrs,
+                        )
         if p.dropped:
             # Make ring overflow visible master-side: the gauge
             # dlrover_telemetry_dropped_total accumulates what the log

@@ -372,6 +372,9 @@ class JobTimeline:
             for phase, ratio in sorted(calibration.ratios().items()):
                 gauge("dlrover_calibration_ratio", ratio,
                       labels=f'{{phase="{phase}"}}')
+            gauge("dlrover_overlap_fraction", calibration.overlap(),
+                  "measured share of device collective seconds hidden "
+                  "under compute (EWMA over capture windows)")
         with self._lock:
             dropped = self._counters.get("telemetry_dropped", 0)
             regressions = self._counters.get("perf_regressions", 0)

@@ -1,0 +1,186 @@
+"""Bucketed collective scheduler: structural comm/compute overlap.
+
+The ZeRO-1 update path in ``trainer/train_lib.py`` historically *hoped*
+XLA's scheduler would overlap the DP reduce-scatter with the tail of the
+backward — nothing in the program's dependence graph demanded it, so
+whether the wire hid under compute was a scheduler accident.  This module
+makes the overlap structural, the TorchTitan composition (PAPERS.md)
+expressed in JAX terms:
+
+* :func:`plan_buckets` splits the gradient tree into ~``bucket_mb``-MB
+  buckets (greedy fill in ``tree_leaves`` order — the order gradients
+  materialize out of the backward).
+* :func:`scheduled_leaf_map` issues one collective wave per bucket with an
+  ``lax.optimization_barrier`` staircase between waves: bucket *b+1*'s
+  collectives cannot be scheduled before bucket *b*'s have produced their
+  outputs, so the collectives serialize among themselves (they share the
+  wire anyway) while staying dependence-free of any *compute* that does
+  not consume them.  Inside the grad-accum ``lax.scan`` this is exactly
+  "launch microbatch *i*'s reduce-scatter while microbatch *i+1*'s
+  backward computes": the scan carry (the 1/dp-sharded accumulator) is
+  the only consumer of the scattered buckets, and the next iteration's
+  backward reads none of it.
+
+Reduce-scatter is linear, so scattering each microbatch's gradient and
+accumulating the *shards* equals scattering the accumulated gradient —
+same math, but the wire rides inside the scan where backward compute can
+hide it, and the accumulator shrinks to 1/dp of the parameter bytes.  The
+price is ``grad_accum``× the wire bytes (each microbatch pays its own
+reduce-scatter); ``auto/tune.py`` prices that trade as hidden-vs-exposed
+time, corrected online by the calibration ledger's measured overlap
+fraction, and ``tools/overlap_bench.py`` certifies the measured overlap
+from device-trace intervals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Default bucket size.  Big enough that per-bucket collective launch
+# latency amortizes, small enough that several buckets exist to pipeline
+# (a single bucket degenerates to the serialized schedule).
+DEFAULT_BUCKET_MB = 4.0
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapPlan:
+    """Static assignment of gradient-tree leaves to collective buckets.
+
+    ``buckets`` holds leaf indices in ``jax.tree_util.tree_leaves`` order;
+    every leaf appears in exactly one bucket, and buckets preserve leaf
+    order (bucket *b*'s indices all precede bucket *b+1*'s).
+    """
+
+    buckets: Tuple[Tuple[int, ...], ...]
+    bucket_bytes: Tuple[int, ...]
+    bucket_mb: float
+    total_bytes: int
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def num_leaves(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+    def describe(self) -> dict:
+        """Summary stats (for ShardedTrain bookkeeping / bench detail)."""
+        return {
+            "num_buckets": self.num_buckets,
+            "num_leaves": self.num_leaves,
+            "bucket_mb": self.bucket_mb,
+            "total_mb": round(self.total_bytes / 1e6, 3),
+            "bucket_bytes": list(self.bucket_bytes),
+        }
+
+
+def plan_buckets(
+    tree: Any,
+    bucket_mb: float = DEFAULT_BUCKET_MB,
+    *,
+    dtype_bytes: int = 4,
+) -> OverlapPlan:
+    """Greedy-fill leaves into ~``bucket_mb``-MB buckets in tree order.
+
+    ``dtype_bytes`` is the *wire* element size (the gradient accumulator's
+    dtype, not each leaf's own — that is what the reduce-scatter ships).
+    A leaf larger than a whole bucket gets a bucket of its own; a zero or
+    negative ``bucket_mb`` degenerates to one bucket holding everything
+    (the serialized schedule, kept valid so callers can express "off").
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    sizes = [int(_leaf_size(leaf)) * dtype_bytes for leaf in leaves]
+    total = sum(sizes)
+    if bucket_mb <= 0:
+        buckets = [tuple(range(len(leaves)))] if leaves else []
+        return OverlapPlan(
+            buckets=tuple(buckets),
+            bucket_bytes=tuple([total] if leaves else []),
+            bucket_mb=float(bucket_mb),
+            total_bytes=total,
+        )
+    cap = int(bucket_mb * 1e6)
+    buckets: List[Tuple[int, ...]] = []
+    bucket_bytes: List[int] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, nbytes in enumerate(sizes):
+        if cur and cur_bytes + nbytes > cap:
+            buckets.append(tuple(cur))
+            bucket_bytes.append(cur_bytes)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(tuple(cur))
+        bucket_bytes.append(cur_bytes)
+    return OverlapPlan(
+        buckets=tuple(buckets),
+        bucket_bytes=tuple(bucket_bytes),
+        bucket_mb=float(bucket_mb),
+        total_bytes=total,
+    )
+
+
+def _leaf_size(leaf: Any) -> int:
+    size = getattr(leaf, "size", None)
+    if size is not None:
+        return size
+    import numpy as np
+
+    return int(np.asarray(leaf).size)
+
+
+def ordered_after(values: Sequence[jax.Array], token: Any):
+    """Return ``values`` rebound so nothing consuming them schedules
+    before ``token`` is materialized.
+
+    ``lax.optimization_barrier`` groups its operands: every input must be
+    computed before any output is released, and XLA may not move ops
+    across the barrier.  Tying the next bucket's inputs to the previous
+    bucket's outputs builds the pipeline staircase without introducing
+    any arithmetic.
+    """
+    flat = tuple(values) + (token,)
+    out = jax.lax.optimization_barrier(flat)
+    return list(out[:-1])
+
+
+def scheduled_leaf_map(
+    fn: Callable[[int, jax.Array], jax.Array],
+    tree: Any,
+    plan: OverlapPlan,
+):
+    """Apply ``fn(leaf_index, leaf)`` leaf-wise in bucket waves.
+
+    Bucket *b+1*'s inputs are barriered on bucket *b*'s outputs, so the
+    per-bucket collectives issue in plan order (a deterministic pipeline)
+    while remaining dependence-free of unrelated compute — the scheduler
+    may hide them under whatever backward/forward work is in flight.
+    Leaf indices follow ``jax.tree_util.tree_leaves`` order, matching
+    :func:`plan_buckets`.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if plan.num_leaves != len(leaves):
+        raise ValueError(
+            f"overlap plan covers {plan.num_leaves} leaves but tree has "
+            f"{len(leaves)} — rebuild the plan against this tree"
+        )
+    out: List[Any] = [None] * len(leaves)
+    token = None
+    for idxs in plan.buckets:
+        ins = [leaves[i] for i in idxs]
+        if token is not None:
+            ins = ordered_after(ins, token)
+        res = [fn(i, x) for i, x in zip(idxs, ins)]
+        for i, r in zip(idxs, res):
+            out[i] = r
+        # The smallest output suffices as the wave token: the barrier only
+        # needs *a* value produced by this wave to order the next one.
+        token = min(res, key=_leaf_size)
+    return jax.tree_util.tree_unflatten(treedef, out)

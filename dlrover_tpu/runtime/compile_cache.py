@@ -124,6 +124,9 @@ def train_cache_key(
     accum_dtype: str = "float32",
     reduce_quant: str = "none",
     zero1: bool = False,
+    overlap: bool = False,
+    overlap_bucket_mb: float = 0.0,
+    allgather_quant: str = "none",
     logical_shape=(),
 ) -> str:
     """Name the compiled train program by everything that shapes it.
@@ -134,8 +137,9 @@ def train_cache_key(
     batch geometry, the optimizer recipe, and the microbatch-engine knobs
     (grad_accum reshapes the whole step program; accum_dtype/reduce_quant
     change the accumulator and reduce lowering; zero1 reshards the whole
-    optimizer update — aliasing any of them would hand a resized world
-    the wrong executable).
+    optimizer update; the overlap-engine knobs move the zero1 collectives
+    into the scan and re-bucket the wave schedule — aliasing any of them
+    would hand a resized world the wrong executable).
 
     ``logical_shape`` is the virtual mesh's resize-INVARIANT bit
     (``VirtualMesh.logical_shape``: the per-process mesh scaled by the
@@ -151,6 +155,7 @@ def train_cache_key(
         type(model_config).__name__, fields, tuple(mesh_shape),
         global_batch_size, seq_len, ce_chunks, optimizer,
         grad_accum, accum_dtype, reduce_quant, zero1,
+        overlap, float(overlap_bucket_mb), allgather_quant,
         tuple(logical_shape),
     ))
 

@@ -41,6 +41,75 @@ def scrub_device_relay_triggers(env: dict) -> dict:
     return env
 
 
+# XLA flag presets, selected by DLROVER_TPU_XLA_PRESET.  The "overlap"
+# preset turns on the TPU latency-hiding scheduler for the collectives
+# the overlap engine does NOT bucket explicitly (fsdp all-gathers, MoE
+# all-to-alls, the non-zero1 gradient all-reduce): the scheduler
+# reorders independent HLO to hide async collective latency under
+# compute, complementing the structural overlap in parallel/overlap.py.
+# TPU-only flags — a CPU XLA build rejects unknown flags at first
+# compile, so apply_xla_preset refuses to install them on CPU worlds.
+ENV_XLA_PRESET = "DLROVER_TPU_XLA_PRESET"
+
+XLA_PRESETS = {
+    "overlap": (
+        "--xla_tpu_enable_latency_hiding_scheduler=true",
+        "--xla_enable_async_collective_permute=true",
+        "--xla_enable_async_all_gather=true",
+        "--xla_tpu_enable_async_collective_fusion=true",
+        "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+        "--xla_tpu_overlap_compute_collective_tc=true",
+    ),
+}
+
+
+def apply_xla_preset(env: Optional[dict] = None, *, platform: str = "") -> str:
+    """Merge the preset named by ``$DLROVER_TPU_XLA_PRESET`` into
+    ``env["XLA_FLAGS"]``.
+
+    Pure env-dict surgery (defaults to ``os.environ``) so it is testable
+    without touching the process: existing XLA_FLAGS are preserved and
+    flags already present win over the preset (user overrides stick).
+    Returns the preset name applied, or "" when none was.  The flags are
+    TPU compiler options; on an explicit CPU world (``platform="cpu"``
+    or ``JAX_PLATFORMS=cpu``) the preset is skipped — XLA:CPU aborts on
+    unknown flags — and "" is returned.
+    """
+    if env is None:
+        env = os.environ
+    name = env.get(ENV_XLA_PRESET, "")
+    if not name:
+        return ""
+    if name not in XLA_PRESETS:
+        logger.warning(
+            "%s=%r is not a known preset (have: %s); ignoring",
+            ENV_XLA_PRESET, name, ", ".join(sorted(XLA_PRESETS)),
+        )
+        return ""
+    platform = platform or env.get("JAX_PLATFORMS", "")
+    if "cpu" in platform:
+        logger.info(
+            "XLA preset %r skipped: TPU scheduler flags on a CPU world",
+            name,
+        )
+        return ""
+    existing = env.get("XLA_FLAGS", "")
+    have = {
+        tok.split("=", 1)[0] for tok in existing.split() if tok
+    }
+    added = [
+        flag for flag in XLA_PRESETS[name]
+        if flag.split("=", 1)[0] not in have
+    ]
+    if added:
+        env["XLA_FLAGS"] = " ".join(filter(None, [existing] + added))
+    logger.info(
+        "XLA preset %r: %d flag(s) added, %d already set",
+        name, len(added), len(XLA_PRESETS[name]) - len(added),
+    )
+    return name
+
+
 def under_agent() -> bool:
     return ENV_COORDINATOR in os.environ
 
@@ -66,7 +135,11 @@ def initialize(force: bool = False):
 
     No-op for single-host jobs (jax initializes locally).  Safe to call
     unconditionally at the top of a training script.
+
+    Applies the ``DLROVER_TPU_XLA_PRESET`` flag preset first (before any
+    jax import can snapshot XLA_FLAGS) — see :func:`apply_xla_preset`.
     """
+    apply_xla_preset()
     if not under_agent():
         logger.info("no agent environment; single-process jax")
         return

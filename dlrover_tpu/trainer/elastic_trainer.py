@@ -99,6 +99,17 @@ class TrainerConfig:
     # parameter update sharded over the data axis, DP reduce lowered as
     # reduce-scatter + all-gather (optimizers/zero1.py).
     zero1: bool = False
+    # Overlap engine (parallel/overlap.py, zero1 only): reduce-scatter
+    # each microbatch's gradient inside the grad-accum scan and pipeline
+    # the param all-gather per-bucket, so the zero1 wire hides under
+    # compute structurally instead of by XLA-scheduler accident.
+    overlap: bool = False
+    # Collective bucket size for the overlap engine's wave schedule.
+    overlap_bucket_mb: float = 4.0
+    # "int8" routes the zero1 param re-replication all-gather through the
+    # block-quantized wire format (quantized_collectives.
+    # quantized_all_gather); "none" = full-precision all-gather.
+    allgather_quant: str = "none"
     # -- silent data corruption ---------------------------------------------
     # Every N steps, digest the post-update train state on device
     # (trainer/state_digest.py) and queue it for the master's cross-replica
@@ -360,6 +371,9 @@ class ElasticTrainer:
                 accum_dtype=config.accum_dtype,
                 reduce_quant=config.reduce_quant,
                 zero1=config.zero1,
+                overlap=config.overlap,
+                overlap_bucket_mb=config.overlap_bucket_mb,
+                allgather_quant=config.allgather_quant,
                 logical_shape=self.vmesh.logical_shape,
             )
         return train_lib.build_sharded_train(
@@ -371,6 +385,9 @@ class ElasticTrainer:
             accum_dtype=config.accum_dtype,
             reduce_quant=config.reduce_quant,
             zero1=config.zero1,
+            overlap=config.overlap,
+            overlap_bucket_mb=config.overlap_bucket_mb,
+            allgather_quant=config.allgather_quant,
             cache_key=cache_key,
         )
 
@@ -704,7 +721,7 @@ class ElasticTrainer:
             wall = time.monotonic() - t_span
             for row in train_lib.microbatch_phase_plan(
                 self.train.grad_accum, self.train.reduce_quant, wall,
-                zero1=self.train.zero1,
+                zero1=self.train.zero1, overlap=self.train.overlap,
             ):
                 telemetry.event(
                     row["phase"], duration_s=row["dur"],
@@ -753,6 +770,9 @@ class ElasticTrainer:
             accum_dtype=config.accum_dtype,
             reduce_quant=config.reduce_quant,
             zero1=config.zero1,
+            overlap=config.overlap,
+            overlap_bucket_mb=config.overlap_bucket_mb,
+            allgather_quant=config.allgather_quant,
             logical_shape=self.vmesh.logical_shape,
         )
 
@@ -779,7 +799,7 @@ class ElasticTrainer:
         # the calibration ratio compares like with like.
         rows = train_lib.microbatch_phase_plan(
             self.train.grad_accum, self.train.reduce_quant, wall,
-            zero1=self.train.zero1,
+            zero1=self.train.zero1, overlap=self.train.overlap,
         )
         device_profile.emit_measured_phases(
             window, step=self.step, t_span=t_span, wall_s=wall,

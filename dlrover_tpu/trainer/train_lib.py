@@ -257,6 +257,15 @@ class ShardedTrain:
     # Leaf counts + per-device bytes from the zero1 spec derivation —
     # what bench/PROFILE report as the replicated-vs-sharded memory model.
     zero1_stats: Optional[Dict[str, Any]] = None
+    # Overlap engine (parallel/overlap.py): True when the program was built
+    # with the scan-interior per-bucket reduce-scatter + per-bucket
+    # all-gather staircase (requires zero1 with an active data axis).
+    overlap: bool = False
+    overlap_bucket_mb: float = 0.0
+    # Re-replication wire format for the zero1 all-gather leg.
+    allgather_quant: str = "none"
+    # plan_buckets().describe() of the compiled bucket assignment.
+    overlap_plan: Optional[Dict[str, Any]] = None
     # Canonical pytree statics the program was compiled against.  TrainState
     # metadata carries apply_fn/tx identities, and optax transforms compare
     # by function identity — so a state built by a DIFFERENT trainer whose
@@ -390,6 +399,9 @@ def build_sharded_train(
     accum_dtype: str = "float32",
     reduce_quant: str = "none",
     zero1: bool = False,
+    overlap: bool = False,
+    overlap_bucket_mb: float = 4.0,
+    allgather_quant: str = "none",
     cache_key: Optional[str] = None,
 ) -> ShardedTrain:
     """Construct init/step functions jitted with mesh shardings.
@@ -435,6 +447,28 @@ def build_sharded_train(
     selection; the param all-gather stays full-precision).  A mesh with no
     ``data`` axis > 1 deactivates it silently.
 
+    ``overlap=True`` (with ``zero1``) replaces the hope that "XLA's
+    scheduler overlaps the reduce-scatter with the tail of the backward"
+    with *structure* (``parallel.overlap``): gradients are reduce-scattered
+    per microbatch inside the scan — reduce-scatter is linear, so
+    accumulating the scattered shards equals scattering the accumulated
+    gradient — and the scan carry shrinks to the 1/dp shard layout.
+    Microbatch *i*'s reduce-scatter has no consumer in microbatch *i+1*'s
+    backward, so the compiled program's dependence graph lets the wire
+    hide under compute instead of leaving it to scheduler luck; the
+    collectives issue in ~``overlap_bucket_mb``-MB bucket waves ordered by
+    an ``optimization_barrier`` staircase, and the post-update param
+    re-replication runs per-bucket the same way.  The trade: ``grad_accum``
+    × the reduce-scatter wire bytes, hidden instead of exposed —
+    ``auto.tune.est_comm_time`` prices it and ``tools/overlap_bench.py``
+    certifies the measured overlap.  ``allgather_quant="int8"`` further
+    routes the re-replication leg through
+    ``quantized_collectives.quantized_all_gather`` (block-quantized
+    travelling shards; quantization noise then does touch the replicated
+    params, a documented tolerance).  Without an active ``data`` axis > 1
+    or without ``zero1``, ``overlap`` deactivates silently, mirroring the
+    ``zero1`` knob.
+
     ``cache_key`` (from ``runtime.compile_cache.train_cache_key``) opts into
     the in-process program memo: the caller asserts that equal keys mean an
     identical (model, optimizer, mesh-shape, batch) recipe, and gets back
@@ -452,6 +486,10 @@ def build_sharded_train(
     if reduce_quant not in ("none", "int8"):
         raise ValueError(
             f"reduce_quant {reduce_quant!r} must be 'none' or 'int8'"
+        )
+    if allgather_quant not in ("none", "int8"):
+        raise ValueError(
+            f"allgather_quant {allgather_quant!r} must be 'none' or 'int8'"
         )
     if cache_key is not None:
         cached = _BUILD_CACHE.get(cache_key)
@@ -544,6 +582,10 @@ def build_sharded_train(
             opt_stats["bytes_per_device_before"] / 1e6,
             opt_stats["bytes_per_device_after"] / 1e6,
         )
+    # Overlap needs the zero1 shard specs to scatter into; without them it
+    # deactivates silently (same contract as the zero1 knob itself).
+    overlap_active = bool(overlap) and zero1_active
+    overlap_plan = None
 
     token_sharding = logical_sharding(mesh, rules, lr.BATCH, lr.ACT_SEQ)
     batch_shardings = {
@@ -565,6 +607,21 @@ def build_sharded_train(
     micro_sharding = NamedSharding(
         mesh, PartitionSpec(None, *token_sharding.spec)
     )
+    if overlap_active:
+        from dlrover_tpu.parallel import overlap as overlap_lib
+
+        overlap_plan = overlap_lib.plan_buckets(
+            abstract_plain.params, overlap_bucket_mb,
+            dtype_bytes=jnp.dtype(accum_jdt).itemsize,
+        )
+        logger.info(
+            "overlap engine: %d bucket(s) of ~%.1f MB over %d grad leaves "
+            "(scan-interior reduce-scatter%s, per-bucket all-gather%s)",
+            overlap_plan.num_buckets, overlap_bucket_mb,
+            overlap_plan.num_leaves,
+            " [int8]" if reduce_quant == "int8" else "",
+            " [int8]" if allgather_quant == "int8" else "",
+        )
 
     def _forward_sums(params, apply_fn, inputs, targets, weights):
         """One forward pass -> (weighted CE sum, token count, aux loss)."""
@@ -623,7 +680,66 @@ def build_sharded_train(
         )
         return fn(leaf)
 
-    def _apply_update(state: TrainState, grads):
+    if overlap_active:
+        _z_param_leaves = jax.tree_util.tree_leaves(zero1_param_shardings)
+        _full_param_leaves = jax.tree_util.tree_leaves(
+            state_shardings.params
+        )
+
+        def _rs_grad_leaf(i, g):
+            """Scatter one gradient leaf to its zero1 update shard (the
+            scan-interior reduce-scatter; int8 when reduce_quant asks)."""
+            z, full = _z_param_leaves[i], _full_param_leaves[i]
+            if reduce_quant == "int8":
+                return _q_reduce_scatter_leaf(g, z, full)
+            return jax.lax.with_sharding_constraint(g, z)
+
+        def _scatter_grads(grads):
+            """Per-bucket reduce-scatter waves over the whole grad tree."""
+            return overlap_lib.scheduled_leaf_map(
+                _rs_grad_leaf, grads, overlap_plan
+            )
+
+        def _ag_param_leaf(i, p):
+            """Re-replicate one updated param leaf (optionally int8)."""
+            from dlrover_tpu.optimizers.zero1 import data_axis_dim
+            from dlrover_tpu.parallel.quantized_collectives import (
+                axis_crosses_dcn,
+                quantized_all_gather,
+                select_reduce_algo,
+            )
+            from dlrover_tpu.runtime.mesh import shard_map_compat
+
+            z, full = _z_param_leaves[i], _full_param_leaves[i]
+            dim = data_axis_dim(z.spec)
+            if allgather_quant == "int8" and dim is not None:
+                algo = select_reduce_algo(
+                    mesh_sizes["data"],
+                    payload_bytes=(
+                        p.size * jnp.dtype(p.dtype).itemsize
+                        // mesh_sizes["data"]
+                    ),
+                    crosses_dcn=axis_crosses_dcn(mesh, "data"),
+                )
+                fn = shard_map_compat(
+                    lambda v: quantized_all_gather(
+                        v, "data", dim=dim, algo=algo
+                    ),
+                    mesh=mesh, in_specs=z.spec, out_specs=full.spec,
+                )
+                return fn(p)
+            return jax.lax.with_sharding_constraint(p, full)
+
+        def _replicate_params(new_params):
+            """Per-bucket all-gather staircase: bucket b's re-replication
+            is ordered before bucket b+1's, so its wire pipelines against
+            the remaining buckets' update arithmetic instead of landing
+            as one post-update wall."""
+            return overlap_lib.scheduled_leaf_map(
+                _ag_param_leaf, new_params, overlap_plan
+            )
+
+    def _apply_update(state: TrainState, grads, scattered: bool = False):
         """Optimizer update: replicated (``apply_gradients``) or ZeRO-1.
 
         The zero1 path is ``apply_gradients`` with three sharding pins
@@ -632,14 +748,20 @@ def build_sharded_train(
         explicitly), params pinned likewise (a free local slice of the
         replicated copy), and the updated params pinned back to their
         replicated layout (the all-gather).  Same math, 1/dp of the
-        update; XLA's scheduler overlaps the reduce-scatter with the tail
-        of the backward and the all-gather with the next step's host-side
-        dispatch since neither blocks any other step computation.
+        update.  Without ``overlap`` the reduce-scatter/all-gather only
+        overlap compute if XLA's scheduler happens to arrange it;
+        ``scattered=True`` says the caller already ran the scan-interior
+        per-bucket reduce-scatter (``parallel.overlap``), and the
+        re-replication then rides the per-bucket staircase.
         """
         if not zero1_active:
             return state.apply_gradients(grads=grads)
         pin = jax.lax.with_sharding_constraint
-        if reduce_quant == "int8":
+        if scattered:
+            # Already reduce-scattered inside the scan; re-pinning the
+            # shard layout is free and keeps the update shard-local.
+            grads = jax.tree.map(pin, grads, zero1_param_shardings)
+        elif reduce_quant == "int8":
             grads = jax.tree.map(
                 _q_reduce_scatter_leaf, grads, zero1_param_shardings,
                 state_shardings.params,
@@ -653,9 +775,12 @@ def build_sharded_train(
             grads, state.opt_state, params_sharded
         )
         new_params = optax.apply_updates(params_sharded, updates)
-        new_params = jax.tree.map(
-            pin, new_params, state_shardings.params
-        )
+        if overlap_active:
+            new_params = _replicate_params(new_params)
+        else:
+            new_params = jax.tree.map(
+                pin, new_params, state_shardings.params
+            )
         return state.replace(
             step=state.step + 1,
             params=new_params,
@@ -676,7 +801,13 @@ def build_sharded_train(
         grads, (ce, aux, total_weight) = jax.grad(loss_fn, has_aux=True)(
             state.params
         )
-        new_state = _apply_update(state, grads)
+        if overlap_active:
+            # Per-bucket reduce-scatter waves directly off the backward:
+            # each leaf's scatter depends only on that leaf's gradient, so
+            # late-layer buckets can ride the wire while early layers are
+            # still back-propagating.
+            grads = _scatter_grads(grads)
+        new_state = _apply_update(state, grads, scattered=overlap_active)
         metrics = {
             "loss": ce,
             "aux_loss": aux,
@@ -723,10 +854,18 @@ def build_sharded_train(
             return ce_sum / w_total + aux / grad_accum, (ce_sum, aux)
 
         params_shardings = state_shardings.params
+        # Overlap: the accumulator lives in the 1/dp zero1 shard layout
+        # and every microbatch reduce-scatters into it (linearity of the
+        # reduce makes scatter-then-accumulate equal accumulate-then-
+        # scatter) — the wire rides inside the scan, where the NEXT
+        # microbatch's backward has no dependence on it and can hide it.
+        accum_shardings = (
+            zero1_param_shardings if overlap_active else params_shardings
+        )
 
         def pin(tree):
             return jax.tree.map(
-                jax.lax.with_sharding_constraint, tree, params_shardings
+                jax.lax.with_sharding_constraint, tree, accum_shardings
             )
 
         grads0 = pin(jax.tree.map(
@@ -738,6 +877,8 @@ def build_sharded_train(
             g, (ce_sum, aux) = jax.grad(micro_loss, has_aux=True)(
                 state.params, mb
             )
+            if overlap_active:
+                g = _scatter_grads(g)
             gacc = pin(jax.tree.map(
                 lambda a, gi: a + gi.astype(a.dtype), gacc, g
             ))
@@ -776,7 +917,7 @@ def build_sharded_train(
         grads = jax.tree.map(
             lambda g, p: g.astype(p.dtype), grads, state.params
         )
-        new_state = _apply_update(state, grads)
+        new_state = _apply_update(state, grads, scattered=overlap_active)
         metrics = {
             "loss": ce_sum / w_total,
             "aux_loss": aux_sum / grad_accum,
@@ -856,6 +997,12 @@ def build_sharded_train(
         reduce_quant=reduce_quant,
         zero1=zero1_active,
         zero1_stats=zero1_stats,
+        overlap=overlap_active,
+        overlap_bucket_mb=overlap_bucket_mb if overlap_active else 0.0,
+        allgather_quant=allgather_quant if overlap_active else "none",
+        overlap_plan=(
+            overlap_plan.describe() if overlap_plan is not None else None
+        ),
         apply_fn=model.apply,
         tx=optimizer,
         batch_avals={
@@ -904,11 +1051,22 @@ def elastic_grad_accum(
     return min(larger) if larger else max(feasible)
 
 
+# Modeled share of each zero1 collective leg the overlap engine hides
+# under compute (parallel/overlap.py: scan-interior reduce-scatter, per-
+# bucket all-gather staircase).  Starting points for the phase model; the
+# calibration ledger's *measured* overlap fraction corrects them online
+# (auto/tune.py apply_calibration) and tools/overlap_bench.py certifies
+# the real number from device intervals.
+OVERLAP_HIDDEN_RS = 0.75
+OVERLAP_HIDDEN_AG = 0.5
+
+
 def microbatch_phase_plan(
     grad_accum: int,
     reduce_quant: str,
     step_seconds: float,
     zero1: bool = False,
+    overlap: bool = False,
 ) -> list:
     """Modeled accumulate/reduce/update breakdown of one microbatched step.
 
@@ -931,11 +1089,22 @@ def microbatch_phase_plan(
     allgather overlaps the next step's host work in the compiled program;
     the modeled rows keep them sequential inside the measured span so the
     timeline stays additive.
+
+    ``overlap=True`` (zero1 only) models the overlap engine's schedule:
+    only the *exposed* remainder of each collective leg is booked as its
+    phase row (``1 - OVERLAP_HIDDEN_RS`` of the reduce-scatter, ``1 -
+    OVERLAP_HIDDEN_AG`` of the allgather) — the hidden share rides under
+    the accumulate rows, so the timeline stays additive and measured
+    step-time attributions do not double-count wire seconds that device
+    traces show hidden under backward compute.
     """
     if zero1:
         rs_frac = 0.015 if reduce_quant == "int8" else 0.04
         update_frac = 0.015
         ag_frac = 0.04
+        if overlap:
+            rs_frac *= 1.0 - OVERLAP_HIDDEN_RS
+            ag_frac *= 1.0 - OVERLAP_HIDDEN_AG
         accum_total = step_seconds * (
             1.0 - rs_frac - update_frac - ag_frac
         )

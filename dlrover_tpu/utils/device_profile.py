@@ -75,6 +75,16 @@ def _is_collective(op_name: str) -> bool:
     return any(key in op_name for key in _COLLECTIVE_KEYS)
 
 
+def _collective_leg(op_name: str) -> Optional[str]:
+    """The collective *leg* an op belongs to (first matching key,
+    normalized to a metric-safe name) — e.g. ``all-reduce.3`` ->
+    ``all_reduce``.  None for non-collective ops."""
+    for key in _COLLECTIVE_KEYS:
+        if key in op_name:
+            return key.replace("-", "_")
+    return None
+
+
 def _is_device_op(name: str) -> bool:
     if name.startswith(ANNOTATION_PREFIX):
         return False
@@ -98,6 +108,13 @@ class DeviceWindow:
     device_total_s: float
     #: Device op rows counted (diagnostic).
     op_count: int = 0
+    #: Per-collective-leg attribution: leg name (``all_reduce``,
+    #: ``all_gather``, ``reduce_scatter``, ...) -> (device seconds,
+    #: overlap fraction vs compute).  What the overlap bench books as the
+    #: per-leg exposed-vs-hidden table.
+    legs: Dict[str, Tuple[float, float]] = dataclasses.field(
+        default_factory=dict
+    )
 
     def seconds(self, kind: str) -> float:
         return self.phases.get(kind, 0.0)
@@ -183,6 +200,8 @@ def parse_device_trace(path: str) -> Optional[DeviceWindow]:
     phases: Dict[str, float] = {}
     compute_iv: List[Tuple[float, float]] = []
     collective_iv: List[Tuple[float, float]] = []
+    leg_iv: Dict[str, List[Tuple[float, float]]] = {}
+    leg_s: Dict[str, float] = {}
     total = 0.0
     ops = 0
     for e in events:
@@ -200,11 +219,15 @@ def parse_device_trace(path: str) -> Optional[DeviceWindow]:
             continue
         if dur <= 0.0:
             continue
-        kind = "collective" if _is_collective(name) else "compute"
+        leg = _collective_leg(name)
+        kind = "collective" if leg else "compute"
         phases[kind] = phases.get(kind, 0.0) + dur
-        (collective_iv if kind == "collective" else compute_iv).append(
-            (t0, t0 + dur)
-        )
+        if leg:
+            collective_iv.append((t0, t0 + dur))
+            leg_iv.setdefault(leg, []).append((t0, t0 + dur))
+            leg_s[leg] = leg_s.get(leg, 0.0) + dur
+        else:
+            compute_iv.append((t0, t0 + dur))
         total += dur
         ops += 1
     if not ops:
@@ -214,11 +237,20 @@ def parse_device_trace(path: str) -> Optional[DeviceWindow]:
         overlap_seconds(compute_iv, collective_iv) / coll_total
         if coll_total > 0.0 else 0.0
     )
+    legs = {
+        leg: (
+            leg_s[leg],
+            min(1.0, overlap_seconds(compute_iv, ivs) / leg_s[leg]),
+        )
+        for leg, ivs in leg_iv.items()
+        if leg_s[leg] > 0.0
+    }
     return DeviceWindow(
         phases=phases,
         overlap_fraction=min(1.0, overlap),
         device_total_s=total,
         op_count=ops,
+        legs=legs,
     )
 
 
@@ -378,5 +410,10 @@ def emit_measured_phases(
     for kind in ("compute", "collective"):
         attrs[f"measured_{kind}"] = round(window.seconds(kind), 6)
         attrs[f"modeled_{kind}"] = round(modeled.get(kind, 0.0), 6)
+    # Per-leg split of the collective seconds (flat attrs — the wire
+    # format is flat floats): which collective hid and which was exposed.
+    for leg, (seconds, frac) in sorted(window.legs.items()):
+        attrs[f"leg_{leg}_s"] = round(seconds, 6)
+        attrs[f"leg_{leg}_overlap"] = round(frac, 4)
     telemetry.event("calibration", **attrs)
     return rows

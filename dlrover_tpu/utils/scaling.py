@@ -50,8 +50,17 @@ def _measure_point(
     zero1: bool = True,
     grad_accum: int = 1,
     reduce_quant: str = "none",
+    profile: bool = True,
 ) -> Dict[str, Any]:
-    """Time ``steps`` sharded train steps on an n-device data submesh."""
+    """Time ``steps`` sharded train steps on an n-device data submesh.
+
+    ``profile=True`` (default) additionally captures ONE extra step under
+    a :class:`~dlrover_tpu.utils.device_profile.DeviceProfiler` window and
+    reports ``comm_fraction`` from *measured* device collective seconds
+    (``comm_source: "measured"``); when the capture fails or yields no
+    collective ops, the modeled phase-plan rows price it instead
+    (``comm_source: "modeled"``) — each point says which it got.
+    """
     import jax
     import numpy as np
 
@@ -101,11 +110,33 @@ def _measure_point(
         r["dur"] for r in rows
         if r["phase"] in ("reduce_scatter", "allgather", "reduce")
     )
+    comm_fraction = comm_s / step_s if step_s else 0.0
+    comm_source = "modeled"
+    if profile and n > 1:
+        # One extra captured step: when the window parses, the comm
+        # fraction comes from measured device collective seconds (share
+        # of device op time, not a cost-model guess).
+        from dlrover_tpu.utils import device_profile
+
+        prof = device_profile.DeviceProfiler(profile_every=1)
+        if prof.arm(0):
+            state, metrics = train.step(state, batch)
+            try:
+                jax.block_until_ready(metrics["loss"])
+            except Exception:  # noqa: BLE001 - capture is best-effort
+                pass
+            window = prof.finish()
+            if window is not None and window.device_total_s > 0.0:
+                comm_fraction = (
+                    window.seconds("collective") / window.device_total_s
+                )
+                comm_source = "measured"
     return {
         "n": n,
         "step_s": step_s,
         "tokens_per_s": batch_size * seq_len / step_s if step_s else 0.0,
-        "comm_fraction": comm_s / step_s if step_s else 0.0,
+        "comm_fraction": comm_fraction,
+        "comm_source": comm_source,
         "zero1": bool(train.zero1),
         "loss": loss,
         "ok": bool(np.isfinite(loss)),
@@ -120,13 +151,14 @@ def _finish(points: list, source: str) -> Dict[str, Any]:
         ideal = base_tps * p["n"]
         p["efficiency"] = p["tokens_per_s"] / ideal if ideal else 0.0
     table = [f"{'n':>3} {'tokens/s':>12} {'speedup':>8} "
-             f"{'efficiency':>10} {'comm%':>6}"]
+             f"{'efficiency':>10} {'comm%':>6} {'src':>9}"]
     for p in points:
         speedup = p["tokens_per_s"] / base_tps if base_tps else 0.0
         table.append(
             f"{p['n']:>3} {p['tokens_per_s']:>12.0f} {speedup:>8.2f} "
             f"{p['efficiency'] * 100:>9.1f}% "
-            f"{p['comm_fraction'] * 100:>5.1f}%"
+            f"{p['comm_fraction'] * 100:>5.1f}% "
+            f"{p.get('comm_source', 'modeled'):>9}"
         )
     return {
         "ok": all(p.get("ok") for p in points) and bool(points),
@@ -256,6 +288,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="True | False (sharded vs replicated update)")
     p.add_argument("--grad-accum", type=int, default=1)
     p.add_argument("--reduce-quant", default="none")
+    p.add_argument("--profile", default="True",
+                   help="True | False (capture one profiled step per "
+                        "point for a measured comm_fraction)")
     args = p.parse_args(argv)
     ns = [int(x) for x in args.ns.split(",") if x.strip()]
     out = measure_scaling(
@@ -267,6 +302,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         zero1=args.zero1 not in ("False", "false", "0"),
         grad_accum=args.grad_accum,
         reduce_quant=args.reduce_quant,
+        profile=args.profile not in ("False", "false", "0"),
     )
     print(json.dumps(out), flush=True)
     return 0 if out.get("ok") else 1

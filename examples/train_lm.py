@@ -81,6 +81,30 @@ def parse_args():
                         "optimizer state + parameter update sharded over "
                         "the data axis (1/dp the opt-state HBM), DP "
                         "reduce lowered as reduce-scatter + all-gather")
+    p.add_argument("--overlap", action="store_true",
+                   help="overlap engine (requires --zero1): reduce-scatter "
+                        "each microbatch's gradient inside the grad-accum "
+                        "scan and pipeline the param all-gather in bucket "
+                        "waves, so the zero1 wire hides under compute "
+                        "structurally (parallel/overlap.py)")
+    p.add_argument("--overlap-bucket-mb", type=float, default=4.0,
+                   help="collective bucket size (MB of wire bytes) for the "
+                        "overlap engine's wave schedule")
+    p.add_argument("--allgather-quant", default="none",
+                   help="wire format of the zero1 param re-replication "
+                        "all-gather: none (full precision) | int8 "
+                        "(block-quantized travelling shards)")
+    p.add_argument("--attention-impl", default="xla",
+                   choices=("xla", "flash", "ring"),
+                   help="attention math: xla (einsum softmax), flash "
+                        "(blocked Pallas fwd+bwd kernel), ring "
+                        "(sequence-parallel blockwise)")
+    p.add_argument("--flash-block-q", type=int, default=0,
+                   help="flash attention query block size (0 = model "
+                        "default)")
+    p.add_argument("--flash-block-kv", type=int, default=0,
+                   help="flash attention key/value block size (0 = model "
+                        "default)")
     p.add_argument("--sdc-check-every", type=int, default=0,
                    help="silent-data-corruption sentry: every N steps, "
                         "digest the post-update train state on device and "
@@ -134,15 +158,20 @@ def main():
     renv.initialize()
     client = renv.master_client()
 
-    cfg = gpt2_config(
-        "124m",
+    model_kw = dict(
         num_layers=args.layers,
         d_model=args.d_model,
         num_heads=args.heads,
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
         remat=args.remat,
+        attention_impl=args.attention_impl,
     )
+    if args.flash_block_q:
+        model_kw["flash_block_q"] = args.flash_block_q
+    if args.flash_block_kv:
+        model_kw["flash_block_kv"] = args.flash_block_kv
+    cfg = gpt2_config("124m", **model_kw)
     trainer = ElasticTrainer(
         cfg,
         TrainerConfig(
@@ -161,6 +190,9 @@ def main():
             accum_dtype=args.accum_dtype,
             reduce_quant=args.reduce_quant,
             zero1=args.zero1,
+            overlap=args.overlap,
+            overlap_bucket_mb=args.overlap_bucket_mb,
+            allgather_quant=args.allgather_quant,
             sdc_check_every=args.sdc_check_every,
             profile_every=args.profile_every,
             world=args.ref_world,

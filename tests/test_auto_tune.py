@@ -44,6 +44,7 @@ def test_enumeration_moe_gets_expert_axis():
     assert all(c.parallel.pipe == 1 for c in cands)
 
 
+@pytest.mark.slow  # compiles every candidate strategy, ~13s on 1 core
 def test_auto_tune_picks_runnable_strategy():
     n = min(8, len(jax.devices()))
     result = auto_tune(
@@ -78,6 +79,7 @@ def test_auto_tune_memory_pruning_rejects_oversized():
     assert dp_only[0].rejected
 
 
+@pytest.mark.slow  # compiles one program per batch multiple, ~22s on 1 core
 def test_auto_tune_batch_search_opt_in():
     """search_batch explores batch multiples, ranks by throughput, and
     reports the winner's batch; default search leaves batch untouched."""

@@ -95,6 +95,7 @@ class _HangingSample:
         time.sleep(3600)
 
 
+@pytest.mark.slow  # waits out the stall watchdog, ~8s on 1 core
 def test_stalled_pipeline_raises_instead_of_hanging():
     """Live-but-wedged workers (e.g. a forked child deadlocked on an
     inherited lock) must surface as an error, never an infinite hang —

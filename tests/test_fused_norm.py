@@ -88,6 +88,7 @@ def test_fused_ln_forward_matches_and_bf16_roundtrip():
     )
 
 
+@pytest.mark.slow  # full train-step build, ~15s on the 1-core CI box
 def test_model_flag_trains_with_fused_ln():
     """fused_ln=True end-to-end: grads flow, loss finite, and the grads
     match the unfused model's on the same params."""
@@ -147,6 +148,7 @@ def test_fused_rmsnorm_grads_match_reference():
         )
 
 
+@pytest.mark.slow  # full llama train-step build, ~14s on the 1-core CI box
 def test_llama_family_trains_with_fused_rmsnorm():
     from dlrover_tpu.models.llama import llama_config
     from dlrover_tpu.models.transformer import TransformerLM

@@ -93,6 +93,7 @@ def test_grad_accum_bf16_accumulator_tolerance():
     )
 
 
+@pytest.mark.slow  # int8 reduce covered by test_zero1/test_quantized_collectives
 def test_grad_accum_int8_reduce_path():
     """reduce_quant="int8" routes the deferred DP reduce through the
     block-quantized all-reduce; on data-replicated gradients the reduce is

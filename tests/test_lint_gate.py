@@ -27,6 +27,7 @@ TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
 EXPECTED_RULES = 12
 
 
+@pytest.mark.slow  # walks every repo file through all 12 rules, ~29s on 1 core
 def test_tracelint_self_hosting_gate(cpu_child_env):
     proc = subprocess.run(
         [sys.executable, TRACELINT,

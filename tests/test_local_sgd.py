@@ -26,6 +26,7 @@ def test_gta_reduce_sign_consensus():
     np.testing.assert_allclose(out["w"], [2.0, -2.0, 3.0])
 
 
+@pytest.mark.slow  # multi-step consensus loop, ~75s on the 1-core CI box
 def test_gta_threshold_drops_weak_consensus():
     deltas = [
         {"w": jnp.asarray([1.0])},

@@ -1,0 +1,218 @@
+"""Overlap engine: bucket planning units + overlapped-vs-serialized
+step parity under the no-retrace pin.
+
+The structural claim the engine rests on — reduce-scatter is linear, so
+per-microbatch scatter into a 1/dp-sharded accumulator equals one
+scatter of the accumulated gradient — is asserted here as end-to-end
+param parity between ``overlap=True`` and ``overlap=False`` builds of
+the SAME mesh shape.  (Different mesh shapes legitimately diverge via
+bf16 layout reassociation; parity is only meaningful holding the mesh
+fixed.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import trace_asserts
+from dlrover_tpu.models.gpt2 import gpt2_config
+from dlrover_tpu.models.transformer import TransformerLM
+from dlrover_tpu.parallel import overlap as overlap_lib
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+from dlrover_tpu.trainer import train_lib
+
+TINY = gpt2_config(
+    "124m", num_layers=2, d_model=64, num_heads=4,
+    vocab_size=256, max_seq_len=64,
+)
+
+#: ZeRO-1 overlap parity: grad-accum reassociation + bf16 activation
+#: noise over a few SGD steps (tests/test_zero1.py tolerances, atol
+#: widened for the scan-interior scatter's extra reassociation).
+PARITY_RTOL, PARITY_ATOL = 1e-4, 5e-5
+#: int8 transports quantize once per microbatch leg.
+INT8_RTOL, INT8_ATOL = 1e-2, 5e-3
+
+
+# ---------------------------------------------------------------------------
+# plan_buckets / scheduled_leaf_map units
+# ---------------------------------------------------------------------------
+
+
+def _tree(sizes):
+    return {f"leaf{i}": jnp.zeros((n,), jnp.float32)
+            for i, n in enumerate(sizes)}
+
+
+def test_plan_buckets_greedy_fill_covers_every_leaf_once():
+    tree = _tree([100, 200, 300, 50, 400])
+    plan = overlap_lib.plan_buckets(tree, bucket_mb=0.001)  # 1000 bytes
+    seen = sorted(i for bucket in plan.buckets for i in bucket)
+    assert seen == list(range(5))
+    assert plan.num_leaves == 5
+    assert plan.total_bytes == sum(
+        leaf.size * 4 for leaf in jax.tree_util.tree_leaves(tree)
+    )
+    # Greedy fill in tree_leaves order: no bucket except the last closes
+    # below the threshold unless the next leaf would overflow it.
+    for bucket, nbytes in zip(plan.buckets[:-1], plan.bucket_bytes[:-1]):
+        assert nbytes + 50 * 4 >= plan.bucket_mb * 1e6 or len(bucket) >= 1
+
+
+def test_plan_buckets_oversized_leaf_gets_own_bucket():
+    tree = _tree([10, 5000, 10])
+    plan = overlap_lib.plan_buckets(tree, bucket_mb=0.001)
+    big = [b for b in plan.buckets if 1 in b]
+    assert big == [[1]] or big == [(1,)] or list(big[0]) == [1]
+
+
+def test_plan_buckets_nonpositive_mb_single_bucket():
+    tree = _tree([100, 200, 300])
+    plan = overlap_lib.plan_buckets(tree, bucket_mb=0)
+    assert plan.num_buckets == 1
+    assert sorted(plan.buckets[0]) == [0, 1, 2]
+
+
+def test_plan_buckets_describe_shape():
+    plan = overlap_lib.plan_buckets(_tree([256, 256]), bucket_mb=4.0)
+    d = plan.describe()
+    assert set(d) >= {"num_buckets", "num_leaves", "bucket_mb", "total_mb"}
+    assert d["num_leaves"] == 2
+
+
+def test_scheduled_leaf_map_applies_fn_per_leaf():
+    tree = _tree([64, 128, 256])
+    plan = overlap_lib.plan_buckets(tree, bucket_mb=0.0005)
+    out = overlap_lib.scheduled_leaf_map(
+        lambda i, leaf: leaf + float(i), tree, plan
+    )
+    leaves = jax.tree_util.tree_leaves(out)
+    for i, leaf in enumerate(leaves):
+        np.testing.assert_allclose(np.asarray(leaf), float(i))
+
+
+def test_scheduled_leaf_map_rejects_mismatched_tree():
+    plan = overlap_lib.plan_buckets(_tree([64, 128]), bucket_mb=1.0)
+    with pytest.raises(ValueError):
+        overlap_lib.scheduled_leaf_map(
+            lambda i, leaf: leaf, _tree([64, 128, 256]), plan
+        )
+
+
+def test_ordered_after_is_value_identity():
+    vals = [jnp.arange(4.0), jnp.ones((2, 2))]
+    out = overlap_lib.ordered_after(vals, jnp.zeros(()))
+    assert len(out) == len(vals)
+    for got, want in zip(out, vals):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# overlapped-vs-serialized end-to-end parity
+# ---------------------------------------------------------------------------
+
+
+def _build(overlap, data, fsdp, grad_accum=1, reduce_quant="none",
+           allgather_quant="none"):
+    mesh = build_mesh(ParallelConfig(data=data, fsdp=fsdp))
+    model = TransformerLM(TINY)
+    # SGD is linear in the gradient: parity isolates the collective
+    # schedule instead of compounding through Adam moments.
+    opt = train_lib.make_optimizer("sgd", learning_rate=1e-2)
+    return train_lib.build_sharded_train(
+        model, opt, mesh, lr.DEFAULT_RULES,
+        global_batch_size=32, seq_len=16,
+        grad_accum=grad_accum, reduce_quant=reduce_quant, zero1=True,
+        overlap=overlap, overlap_bucket_mb=0.2,
+        allgather_quant=allgather_quant,
+    )
+
+
+def _batch(train, seed):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, 256, size=(32, 17), dtype=np.int32)
+    return train_lib.shard_batch(
+        {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}, train
+    )
+
+
+def _run_steps(train, steps=3):
+    state = train.init(jax.random.PRNGKey(0))
+    state, metrics = train.step(state, _batch(train, 0))  # pays the trace
+    with trace_asserts.assert_no_retrace("train_step"):
+        for seed in range(1, steps):
+            state, metrics = train.step(state, _batch(train, seed))
+    jax.block_until_ready(metrics["loss"])
+    return state, float(metrics["loss"])
+
+
+def _flat_params(state):
+    return np.concatenate([
+        np.asarray(leaf, dtype=np.float64).ravel()
+        for leaf in jax.tree_util.tree_leaves(state.params)
+    ])
+
+
+@pytest.mark.parametrize(
+    "data,fsdp,grad_accum",
+    [
+        (4, 2, 2),
+        # Extra mesh shapes compile two more full builds each (~15s on the
+        # 1-core CI box); dp4-ga2 stays as the tier-1 witness.
+        pytest.param(4, 2, 1, marks=pytest.mark.slow),
+        pytest.param(2, 4, 2, marks=pytest.mark.slow),
+    ],
+    ids=["dp4-ga2", "dp4-ga1", "dp2-ga2"],
+)
+def test_overlap_matches_serialized(data, fsdp, grad_accum):
+    """Scan-interior per-bucket reduce-scatter + per-bucket all-gather
+    lands on the same params as the serialized end-of-step chain — the
+    linearity invariant the whole engine rests on — with zero
+    steady-state retraces on either build."""
+    if len(jax.devices()) < data * fsdp:
+        pytest.skip("needs the virtual multi-device mesh")
+    serial_state, serial_loss = _run_steps(
+        _build(False, data, fsdp, grad_accum)
+    )
+    overlap_state, overlap_loss = _run_steps(
+        _build(True, data, fsdp, grad_accum)
+    )
+    assert np.isfinite(serial_loss) and np.isfinite(overlap_loss)
+    np.testing.assert_allclose(
+        _flat_params(overlap_state), _flat_params(serial_state),
+        rtol=PARITY_RTOL, atol=PARITY_ATOL,
+    )
+
+
+def test_overlap_int8_transports_match_within_quant_tolerance():
+    """int8 reduce-scatter per microbatch + int8 re-replication
+    all-gather: one quantization round per leg, so the bound scales with
+    grad_accum but stays small for gradient-sized values."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual multi-device mesh")
+    serial_state, _ = _run_steps(
+        _build(False, 4, 2, grad_accum=2, reduce_quant="int8")
+    )
+    overlap_state, _ = _run_steps(
+        _build(True, 4, 2, grad_accum=2, reduce_quant="int8",
+               allgather_quant="int8")
+    )
+    np.testing.assert_allclose(
+        _flat_params(overlap_state), _flat_params(serial_state),
+        rtol=INT8_RTOL, atol=INT8_ATOL,
+    )
+
+
+def test_overlap_build_reports_plan():
+    """The ShardedTrain handle carries the bucket plan the build used —
+    what the overlap bench books as ``bucket_plan``."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual multi-device mesh")
+    train = _build(True, 4, 2, grad_accum=2)
+    assert train.overlap
+    plan = train.overlap_plan
+    assert plan is not None and plan["num_buckets"] >= 2
+    serial = _build(False, 4, 2, grad_accum=2)
+    assert serial.overlap_plan is None

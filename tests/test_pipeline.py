@@ -39,6 +39,7 @@ def _reshape_params_for_stages(params, stages):
     return out
 
 
+@pytest.mark.slow  # forward-only path subsumed by the grads-match parity tests
 def test_pipeline_matches_sequential_forward():
     cfg1 = _tiny(pp=1)
     cfg2 = _tiny(pp=2, micro=2)
@@ -149,6 +150,7 @@ def test_pipeline_validates_config():
         )
 
 
+@pytest.mark.slow  # pp x tp build compiles a second mesh, ~12s on 1 core
 def test_pipeline_composes_with_tensor_parallel():
     """pp=2 x tp=2 x dp=2 (the round-2 verdict's untested composition):
     loss parity with the unsharded pp=1 reference on the same params."""
@@ -237,6 +239,7 @@ def test_schedule_accounting_parity_and_interleaving_bounds():
     assert gap_big_m < gap_small_m
 
 
+@pytest.mark.slow  # forward-only check subsumed by the interleave grads-match test
 def test_circular_interleave_matches_sequential_forward():
     """pipeline_interleave=2 (circular, interleaved-1F1B-equivalent
     schedule) computes the SAME function as the plain stack on the same
@@ -285,6 +288,7 @@ def test_circular_interleave_grads_match_sequential():
         )
 
 
+@pytest.mark.slow  # sharded circular-interleave build, ~15s on 1 core
 def test_circular_interleave_sharded_train_step():
     """pp=2 x dp=2 x v=2 over the virtual mesh: the sharded train step
     runs and first-step loss matches pp=1."""

@@ -24,6 +24,7 @@ def test_gae_matches_hand_computation():
     np.testing.assert_allclose(ret[0], [1.0, 1.0, 1.0], atol=1e-6)
 
 
+@pytest.mark.slow  # rollout generation compile, ~7s on 1 core
 def test_rollout_fills_response_region():
     trainer = PPOTrainer(
         tiny_cfg(),

@@ -149,6 +149,7 @@ def test_corrupt_shard_degrades_across_worlds(tmp_path):
     _assert_tree_equal(loaded, good)
 
 
+@pytest.mark.slow  # torn-layout rejection also covered by the faultline matrix
 def test_partial_step_dir_still_rejected(tmp_path):
     """3-of-4 host files is not a world: the genuinely-partial step is
     skipped (not half-restored) and the older committed step wins."""
@@ -509,6 +510,7 @@ def _live_trainer(ckpt_dir, world, ckpt_every=2):
     )
 
 
+@pytest.mark.slow  # 4->2->1->4 relayout chain compiles every world, ~130s on 1 core
 def test_live_relayout_matches_checkpoint_reshard(tmp_path, monkeypatch):
     """Shrink/grow chain: the state every live relayout in a 4 -> 2 -> 1
     -> 4 cycle lays out in memory is BITWISE the state the storage

@@ -71,6 +71,7 @@ def test_ring_grads(rng, seq4_mesh):
         )
 
 
+@pytest.mark.slow  # full ring-attention model build; kernel parity is unit-tested above
 def test_ring_model_end_to_end(rng):
     """Full TransformerLM with attention_impl='ring' trains under a seq mesh."""
     from dlrover_tpu.models.gpt2 import gpt2_config

@@ -37,6 +37,7 @@ def _cfg(**kw):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # decode + full-forward compile pair, ~11s on 1 core
 def test_kv_cache_decode_matches_full_forward():
     """The cached decode path must produce the same next-token logits as
     running the full sequence through the non-decode model."""
@@ -96,6 +97,7 @@ def test_generation_backend_jitted_loop():
     np.testing.assert_array_equal(np.asarray(tokens), np.asarray(tokens2))
 
 
+@pytest.mark.slow  # generate + reforward compiles two programs, ~12s on 1 core
 def test_generation_backend_greedy_matches_reforward_argmax():
     """temperature->0 sampling through the cache must follow the argmax
     of the full-reforward logits (the two rollout paths agree)."""

@@ -7,6 +7,7 @@ import threading
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dlrover_tpu.common.storage import CheckpointDirLayout, PosixDiskStorage
 from dlrover_tpu.master.rdzv_manager import ElasticTrainingRendezvousManager
@@ -263,6 +264,7 @@ def test_load_retry_stays_in_lockstep_across_hosts(tmp_path):
         saver.stop()
 
 
+@pytest.mark.slow  # full q8-adam train-step build, ~8s on 1 core
 def test_make_optimizer_q8_adam_trains():
     """Round-2 verdict: the tested q8 Adam must be reachable from
     make_optimizer and drive a full sharded train step."""

@@ -461,6 +461,7 @@ def test_servicer_routes_compile_event_to_ledger():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # subprocess jax import + compile, ~5s on 1 core
 def test_trace_steps_tool_zero_syncs_in_pipelined_mode():
     from tools.trace_steps import run_trace
 

@@ -448,6 +448,25 @@ def test_straggler_registered_in_default_chain():
 # -- metrics exposition ------------------------------------------------------
 
 
+def test_render_metrics_overlap_fraction_gauge():
+    """A calibration ledger that has observed a measured overlap fraction
+    renders it as the ``dlrover_overlap_fraction`` gauge."""
+    from dlrover_tpu.master.calibration import CalibrationLedger
+    from dlrover_tpu.master.timeline import JobTimeline
+
+    led = CalibrationLedger()
+    led.observe("k1", "reduce_scatter", measured=0.9, modeled=1.0)
+    led.observe_overlap("k1", 0.69)
+    text = JobTimeline().render_metrics(calibration=led)
+    assert "dlrover_overlap_fraction 0.69" in text
+    # Never observed -> the gauge reads 0, not a stale or modeled value.
+    bare = CalibrationLedger()
+    bare.observe("k1", "reduce_scatter", measured=0.9, modeled=1.0)
+    assert "dlrover_overlap_fraction 0" in JobTimeline().render_metrics(
+        calibration=bare
+    )
+
+
 def test_render_metrics_goodput_matches_speed_monitor():
     sm = SpeedMonitor()
     now = time.time()

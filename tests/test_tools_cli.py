@@ -278,6 +278,83 @@ def test_embed_bench_gate_predicate():
     assert failed == ["cache_hits_happen", "rows_served"]
 
 
+def test_overlap_bench_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "overlap_bench.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--out" in out.stdout and "--bucket-mb" in out.stdout
+    assert "--grad-accum" in out.stdout and "--windows" in out.stdout
+    assert "--reduce-quant" in out.stdout
+    assert "--allgather-quant" in out.stdout
+
+
+def test_overlap_bench_gate_predicate():
+    """The OVERLAP.json ok gate is a pure predicate; each certification
+    leg (measured windows, strictly-higher hidden fraction, tokens/s no
+    worse, parity, no retraces) fails as its own named check."""
+    tool = _load_module(
+        os.path.join(REPO, "tools", "overlap_bench.py"), "_overlap_bench"
+    )
+
+    def build(hidden, tokens, retraces=0, windows=3):
+        return {
+            "windows": windows, "hidden_fraction": hidden,
+            "tokens_per_s": tokens, "retraces": retraces,
+        }
+
+    result = {
+        "serialized": build(0.15, 1000.0),
+        "overlapped": build(0.66, 3300.0),
+        "parity": {"max_score": 0.8},
+    }
+    ok, failed = tool.evaluate_overlap_gate(result)
+    assert ok and failed == []
+
+    unmeasured = dict(result, overlapped=build(0.66, 3300.0, windows=0))
+    ok, failed = tool.evaluate_overlap_gate(unmeasured)
+    assert not ok and failed == ["windows_measured"]
+
+    not_higher = dict(result, overlapped=build(0.15, 3300.0))
+    ok, failed = tool.evaluate_overlap_gate(not_higher)
+    assert not ok and failed == ["overlap_fraction_higher"]
+
+    slower = dict(result, overlapped=build(0.66, 900.0))
+    ok, failed = tool.evaluate_overlap_gate(slower)
+    assert not ok and failed == ["tokens_per_s_no_worse"]
+
+    drifted = dict(result, parity={"max_score": 1.7})
+    ok, failed = tool.evaluate_overlap_gate(drifted)
+    assert not ok and failed == ["grad_parity"]
+
+    retraced = dict(result, overlapped=build(0.66, 3300.0, retraces=2))
+    ok, failed = tool.evaluate_overlap_gate(retraced)
+    assert not ok and failed == ["steady_state_no_retrace"]
+
+
+def test_overlap_json_artifact_certified():
+    """The committed OVERLAP.json must be a real certified run: gate
+    re-evaluates to ok on the booked numbers, the overlap is measured
+    (capture windows parsed), and the hidden fraction is strictly higher
+    for the overlapped build."""
+    path = os.path.join(REPO, "OVERLAP.json")
+    with open(path) as f:
+        result = json.load(f)
+    tool = _load_module(
+        os.path.join(REPO, "tools", "overlap_bench.py"), "_overlap_bench2"
+    )
+    ok, failed = tool.evaluate_overlap_gate(result)
+    assert ok, f"OVERLAP.json fails its own gate: {failed}"
+    assert result["ok"] is True
+    assert result["overlapped"]["windows"] >= 1
+    assert (
+        result["overlapped"]["hidden_fraction"]
+        > result["serialized"]["hidden_fraction"]
+    )
+
+
 def test_train_rec_help(cpu_child_env):
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "train_rec.py"),
@@ -399,6 +476,7 @@ def test_job_timeline_converts_wire_dump(tmp_path, monkeypatch):
     assert any(e["ph"] == "i" for e in trace["traceEvents"])
 
 
+@pytest.mark.slow  # subprocess jax import + compile, ~8s on 1 core
 def test_trace_steps_microbatch_phases():
     """With the microbatch engine on, trace_steps attaches per-microbatch
     accumulate/reduce/update phase rows that tile the measured step."""
@@ -418,6 +496,7 @@ def test_trace_steps_microbatch_phases():
     assert all(r["dur_s"] > 0 for r in rows)
 
 
+@pytest.mark.slow  # subprocess jax import + compile, ~4s on 1 core
 def test_train_lm_timeline_flag(tmp_path, monkeypatch):
     """The example's ``--timeline`` writes a Chrome trace holding the run's
     step spans (standalone mode: the local ring is the source)."""

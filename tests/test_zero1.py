@@ -197,7 +197,15 @@ def test_quantized_reduce_scatter_indivisible_raises():
 # -- parity vs the replicated update ------------------------------------------
 
 
-@pytest.mark.parametrize("data,fsdp", [(2, 4), (4, 2)])
+@pytest.mark.parametrize(
+    "data,fsdp",
+    [
+        # (2, 4) compiles a second full mesh shape, ~11s on 1 core;
+        # (4, 2) stays as the tier-1 witness.
+        pytest.param(2, 4, marks=pytest.mark.slow),
+        (4, 2),
+    ],
+)
 def test_zero1_parity(data, fsdp):
     """Sharded update == replicated update at dp in {2, 4}: same loss,
     same parameters after one SGD step, and the optimizer state actually
@@ -221,6 +229,7 @@ def test_zero1_parity(data, fsdp):
     assert stats["bytes_per_device_after"] < stats["bytes_per_device_before"]
 
 
+@pytest.mark.slow  # 3-step trajectory doubles the parity compile, ~11s on 1 core
 def test_zero1_loss_trajectory_parity():
     """Three steps on fresh batches: the trajectories stay within bf16
     layout-reassociation tolerance of each other (no compounding drift at
@@ -246,7 +255,15 @@ def test_zero1_grad_accum_parity():
     )
 
 
-@pytest.mark.parametrize("grad_accum", [1, 4])
+@pytest.mark.parametrize(
+    "grad_accum",
+    [
+        # grad_accum=1 is the degenerate scan; =4 exercises the same
+        # transport plus accumulation and stays as the tier-1 witness.
+        pytest.param(1, marks=pytest.mark.slow),
+        4,
+    ],
+)
 def test_zero1_int8_reduce_parity(grad_accum):
     """zero1 + int8: the quantized payload rides the reduce-scatter leg
     only (params all-gather back in full precision), so the update stays
@@ -377,6 +394,7 @@ def test_pick_grad_accum_zero1_discounts_opt_state():
 # -- cross-world restore of sharded optimizer state ---------------------------
 
 
+@pytest.mark.slow  # cross-world restores also covered by test_resize's matrix
 def test_zero1_opt_state_cross_world_restore(tmp_path, monkeypatch):
     """A train state whose opt_state carries the data axis round-trips
     through the PR 7 cross-world checkpoint path: saved by a 2-host world,

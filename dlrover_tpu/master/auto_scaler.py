@@ -55,13 +55,19 @@ class ServeScalePolicy:
     when BOTH latency and occupancy sit comfortably low — shrinking on
     latency alone would thrash against a bursty arrival process.
     ``min_qps`` ignores idle/startup ledgers whose quantiles carry no
-    signal.
+    signal, and ``min_samples`` ignores p95s computed from fewer completed
+    requests than that (a quantile over two latencies is noise; occupancy
+    still acts).  ``prefill_backlog_high`` drives the DISAGGREGATED
+    prefill pool: queued prompts per prefill replica above it spawn a new
+    prefill replica, independent of the decode pool's signals.
     """
 
     slo_p95_s: float = 1.0
     occupancy_high: float = 0.85
     occupancy_low: float = 0.30
     min_qps: float = 0.0
+    min_samples: int = 8
+    prefill_backlog_high: float = 4.0
 
 
 class JobAutoScaler:
@@ -272,6 +278,17 @@ class JobAutoScaler:
         target = self.target
         p95 = ledger["p95_s"]
         occupancy = ledger["occupancy"]
+        # A p95 backed by too few completed requests is treated as
+        # unknown: it neither triggers a breach scale-out nor licenses an
+        # idle scale-in (occupancy, always well-sampled, still acts).
+        if ledger.get("p95_n", float("inf")) < policy.min_samples:
+            if occupancy > policy.occupancy_high:
+                self.set_target(
+                    target + self.node_unit,
+                    reason=f"serve: occupancy {occupancy:.2f} (p95 "
+                    "unconfident)",
+                )
+            return
         if p95 > policy.slo_p95_s or occupancy > policy.occupancy_high:
             self.set_target(
                 target + self.node_unit,

@@ -185,18 +185,29 @@ class SpeedMonitor:
         slots: float = 0.0,
         requests: float = 0.0,
         tokens: float = 0.0,
+        p95_n: float = 1e9,
+        spec_accept_rate: float = 0.0,
+        spec_proposed: float = 0.0,
+        spec_accepted: float = 0.0,
+        decode_step_p95_s: float = 0.0,
         **_ignored,
     ):
         """A serving replica's stats snapshot (its ``serve`` telemetry
         event).  Newest-wins per replica; unknown attrs are ignored so
-        engines can grow the event without breaking older masters."""
+        engines can grow the event without breaking older masters.
+        ``p95_n`` defaults to effectively-infinite so snapshots from
+        engines that predate quantile confidence stay actionable."""
         with self._lock:
             self._serve_events += 1
             self._serve_stats[node_id] = {
                 "qps": float(qps), "p50_s": float(p50_s),
                 "p95_s": float(p95_s), "occupancy": float(occupancy),
                 "slots": float(slots), "requests": float(requests),
-                "tokens": float(tokens),
+                "tokens": float(tokens), "p95_n": float(p95_n),
+                "spec_accept_rate": float(spec_accept_rate),
+                "spec_proposed": float(spec_proposed),
+                "spec_accepted": float(spec_accepted),
+                "decode_step_p95_s": float(decode_step_p95_s),
             }
 
     def evict_serve(self, node_id: int):
@@ -302,18 +313,41 @@ class SpeedMonitor:
         with self._lock:
             stats = list(self._serve_stats.values())
             n = len(stats)
+            worst = max(
+                stats, key=lambda s: s["p95_s"], default=None
+            )
+            spec_prop = sum(
+                s.get("spec_proposed", 0.0) for s in stats
+            )
+            spec_acc = sum(
+                s.get("spec_accepted", 0.0) for s in stats
+            )
             return {
                 "serve_events": float(self._serve_events),
                 "replicas": float(n),
                 "qps": sum(s["qps"] for s in stats),
                 "p50_s": max((s["p50_s"] for s in stats), default=0.0),
                 "p95_s": max((s["p95_s"] for s in stats), default=0.0),
+                # Sample count behind the worst replica's p95 — what the
+                # scale policy's min_samples confidence gate reads.
+                "p95_n": (
+                    worst.get("p95_n", 1e9) if worst is not None else 0.0
+                ),
+                "decode_step_p95_s": max(
+                    (s.get("decode_step_p95_s", 0.0) for s in stats),
+                    default=0.0,
+                ),
                 "occupancy": (
                     sum(s["occupancy"] for s in stats) / n if n else 0.0
                 ),
                 "slots": sum(s["slots"] for s in stats),
                 "requests": sum(s["requests"] for s in stats),
                 "tokens": sum(s["tokens"] for s in stats),
+                "spec_proposed": spec_prop,
+                "spec_accepted": spec_acc,
+                "spec_accept_rate": (
+                    spec_acc / spec_prop if spec_prop else 0.0
+                ),
                 "swaps": float(self._swaps),
                 "swap_rollbacks": float(self._swap_rollbacks),
                 "swap_s_total": self._swap_s_total,

@@ -305,6 +305,14 @@ class JobTimeline:
                   labels='{quantile="0.95"}')
             gauge("dlrover_serve_slot_occupancy", serve["occupancy"],
                   "mean fraction of KV-cache slots live (0..1)")
+            gauge("dlrover_serve_spec_accept_rate",
+                  serve.get("spec_accept_rate", 0.0),
+                  "speculative-decode acceptance: draft tokens the "
+                  "target verified, over tokens proposed (greedy rows)")
+            gauge("dlrover_serve_decode_step_p95_seconds",
+                  serve.get("decode_step_p95_s", 0.0),
+                  "p95 wall seconds of decode-advancing engine steps "
+                  "(worst replica) - prefill interference shows up here")
             gauge("dlrover_serve_requests_total", serve["requests"],
                   "serving requests completed, summed over replicas")
             gauge("dlrover_serve_tokens_total", serve["tokens"],

@@ -281,9 +281,30 @@ class Attention(nn.Module):
                 cached_v.value, v.astype(self.dtype), row_start
             )
             index.value = jnp.max(row_start) + t
-            out = cached_attention(
-                q, cached_k.value, cached_v.value, q_positions
-            )
+            if self.attention_impl == "flash" and t >= 16:
+                # Prefill chunks through the Pallas flash kernel: a chunk
+                # this wide is a prompt prefill starting at position 0
+                # (the serving engine's bucketed prefill; speculative
+                # verify chunks are capped below 16 and single-token
+                # decode is t == 1, so both stay on the cached path
+                # below).  At position 0 the chunk IS the whole written
+                # cache prefix, so causal flash over the fresh K/V equals
+                # cached attention — without materializing [t, max_seq]
+                # logits against the mostly-empty pool.  Narrower chunks
+                # fall back to XLA: the kernel's 16-sublane tile floor
+                # means a narrow bucket would be pure pad.
+                from dlrover_tpu.ops import flash_attention as fa
+
+                out = fa.mha(
+                    q, k.astype(self.dtype), v.astype(self.dtype),
+                    causal=True,
+                    block_q=self.flash_block_q,
+                    block_kv=self.flash_block_kv,
+                )
+            else:
+                out = cached_attention(
+                    q, cached_k.value, cached_v.value, q_positions
+                )
         elif self.attention_impl == "ring":
             # Ring CP: sequence stays sharded; K/V stream around the ring.
             from dlrover_tpu.parallel.ring_attention import ring_attention

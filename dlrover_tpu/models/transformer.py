@@ -95,9 +95,10 @@ class TransformerConfig:
     # KV cache ("cache" collection, [B, max_seq_len, H_kv, hd] per layer)
     # and attends single-token queries against it.  Param tree is
     # UNCHANGED vs decode=False — the same weights serve training and
-    # generation.  Requires attention_impl="xla" (flash/ring kernels are
-    # seq-blocked; a 1-token query wants the einsum path) and no
-    # pipelining.
+    # generation.  attention_impl may be "xla" or "flash" (flash serves
+    # wide position-0 prefill chunks through the Pallas kernel and falls
+    # back to the cached einsum path for single-token/narrow queries;
+    # "ring" has no decode path).  No pipelining.
     decode: bool = False
     # Circular (interleaved-1F1B-equivalent) schedule: each device holds
     # `interleave` layer chunks and every microbatch makes that many laps
@@ -130,11 +131,11 @@ class TransformerConfig:
         # the remat string would be wrong.
         remat_policies.validate(self.remat, self.attention_impl)
         if self.decode:
-            if self.attention_impl != "xla":
+            if self.attention_impl == "ring":
                 raise ValueError(
-                    "decode=True requires attention_impl='xla' (got "
-                    f"{self.attention_impl!r}); the blocked flash/ring "
-                    "kernels have no single-token query path"
+                    "decode=True requires attention_impl='xla' or 'flash' "
+                    "(got 'ring'); ring streams K/V over a sharded "
+                    "sequence axis a decode cache does not have"
                 )
             if self.pipeline_stages > 1:
                 raise ValueError("decode=True requires pipeline_stages=1")

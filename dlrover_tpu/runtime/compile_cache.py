@@ -167,6 +167,9 @@ def serve_cache_key(
     slots: int,
     buckets,
     max_top_k: int = 0,
+    attention_impl: str = "",
+    tp=(),
+    spec: int = 0,
 ) -> str:
     """Name the serving program set by everything that shapes it.
 
@@ -177,6 +180,23 @@ def serve_cache_key(
     Equal keys mean a rebuilt engine — an elastic replica restart, or a
     second engine in-process — can reuse traced programs and AOT
     executables wholesale.
+
+    The config fields already ride the key via ``vars``, but three knobs
+    are carried EXPLICITLY so aliasing bugs cannot creep back in through
+    config normalization (``decode_config`` rewrites the config before
+    the programs see it):
+
+    * ``attention_impl`` — the impl the decode-mode twin actually runs
+      (flash and XLA prefill lower differently; colliding them in the
+      process-wide ``_PROGRAMS`` memo would hand a flash engine an XLA
+      executable or vice versa);
+    * ``tp`` — ``(logical_tp, physical_tp)`` of the serve TP fold.  The
+      logical width names the program FAMILY (stable across fleet
+      resizes, mirroring ``train_cache_key(logical_shape=...)``); the
+      physical width names the concrete fold, so re-folding back to a
+      previously-seen width is a memo hit — zero retrace;
+    * ``spec`` — the speculative-decode γ (proposal length); the verify
+      program's chunk width is ``γ+1`` and must not alias plain decode.
     """
     fields = tuple(sorted(
         (k, repr(v)) for k, v in vars(model_config).items()
@@ -184,4 +204,5 @@ def serve_cache_key(
     return repr((
         "serve", type(model_config).__name__, fields, tuple(mesh_shape),
         slots, tuple(buckets), max_top_k,
+        attention_impl, tuple(tp), int(spec),
     ))

@@ -12,6 +12,7 @@ from dlrover_tpu.serving.bucketing import (  # noqa: F401
     pick_bucket,
 )
 from dlrover_tpu.serving.engine import (  # noqa: F401
+    PrefilledPage,
     Request,
     RequestResult,
     ServingEngine,
@@ -21,3 +22,8 @@ from dlrover_tpu.serving.fleet import (  # noqa: F401
     ReplicaFleet,
 )
 from dlrover_tpu.serving.frontend import ServeFrontend  # noqa: F401
+from dlrover_tpu.serving.tp import (  # noqa: F401
+    ServeTPMesh,
+    build_tp_mesh,
+    fold_width,
+)

@@ -21,6 +21,20 @@ retrace in steady state —
   live; free slots compute garbage the host ignores and the next
   ``insert`` overwrites.
 
+Two optional layers ride the same programs:
+
+* **Tensor parallelism** (``tp=ServeTPMesh``): params and the KV pool
+  shard GSPMD-style over the mesh's ``tensor`` axis under
+  ``serving/tp.py``'s Megatron rule table — per-device pool bytes fall
+  as 1/tp, and the program memo keys on ``(logical_tp, physical_tp)``
+  so a fleet resize that folds back to a seen width retraces nothing.
+* **Speculative decoding** (:class:`SpecPrograms`): a draft model
+  proposes γ greedy tokens in one scanned program; a verify program
+  runs the γ+1-wide chunk through the target once and accepts the
+  longest matching prefix plus one bonus token — lossless for greedy
+  rows (bitwise the plain decode path), graceful n=0 fallback for
+  sampled rows.
+
 Programs are memoized process-wide by ``compile_cache.serve_cache_key``,
 and :meth:`ServePrograms.aot_compile` lower+compiles all of them ahead of
 the first request (AOT warm-start) — a second engine on the same key pays
@@ -29,27 +43,46 @@ zero trace and zero compile.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any, Dict, Optional, Tuple
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.models.transformer import TransformerConfig, TransformerLM
 from dlrover_tpu.runtime.compile_cache import serve_cache_key
+from dlrover_tpu.serving.tp import (
+    SERVE_TP_RULES,
+    ServeTPMesh,
+    param_shardings,
+    validate_tp_config,
+)
 from dlrover_tpu.trainer import train_lib
 
 NEG_INF = -1e15
 
+#: Speculative proposal-length ceiling: the verify chunk is ``γ+1`` wide
+#: and must stay under the decode-mode flash-prefill threshold (16) so a
+#: verify step never takes the position-0-only kernel path.
+MAX_SPEC_TOKENS = 14
+
 
 def decode_config(config: TransformerConfig) -> TransformerConfig:
     """The decode-mode twin of a training config: same param tree, KV
-    cache enabled, training-only machinery (remat/pipeline/flash) off."""
+    cache enabled, training-only machinery (remat/pipeline) off.  The
+    attention impl is PRESERVED for ``"xla"``/``"flash"`` — flash serves
+    the bucketed prefill chunks (models/attention.py decode branch) —
+    and only ``"ring"`` (no decode path) normalizes to ``"xla"``."""
     return dataclasses.replace(
         config,
         decode=True,
-        attention_impl="xla",
+        attention_impl=(
+            "xla" if config.attention_impl == "ring"
+            else config.attention_impl
+        ),
         remat="none",
         pipeline_stages=1,
         num_microbatches=0,
@@ -95,10 +128,32 @@ def sample_tokens(
     return tokens, logp
 
 
+def _programs_key(
+    config: TransformerConfig,
+    slots: int,
+    buckets: Tuple[int, ...],
+    max_top_k: int,
+    tp: Optional[ServeTPMesh],
+) -> str:
+    """The ONE spelling of a program set's memo key (used by both
+    :func:`get_programs` and ``ServePrograms.__init__`` so they can
+    never drift): the attention impl is the decode twin's (what the
+    programs actually lower), and ``tp`` carries (logical, physical)."""
+    twin = decode_config(config)
+    return serve_cache_key(
+        config,
+        slots=slots,
+        buckets=tuple(sorted(buckets)),
+        max_top_k=max_top_k,
+        attention_impl=twin.attention_impl,
+        tp=(tp.logical_tp, tp.physical_tp) if tp is not None else (),
+    )
+
+
 class ServePrograms:
     """The jitted prefill/insert/decode triple for one (config, slots,
-    buckets, max_top_k) tuple.  Obtain through :func:`get_programs` so
-    equal keys share traced programs and AOT executables."""
+    buckets, max_top_k, tp) tuple.  Obtain through :func:`get_programs`
+    so equal keys share traced programs and AOT executables."""
 
     def __init__(
         self,
@@ -106,6 +161,7 @@ class ServePrograms:
         slots: int,
         buckets: Tuple[int, ...],
         max_top_k: int = 64,
+        tp: Optional[ServeTPMesh] = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -127,37 +183,141 @@ class ServePrograms:
         self.slots = slots
         self.buckets = buckets
         self.max_top_k = max_top_k
+        self.tp = tp
         self.model = TransformerLM(self.config)
-        self.cache_key = serve_cache_key(
-            config, slots=slots, buckets=buckets, max_top_k=max_top_k
+        self.cache_key = _programs_key(
+            config, slots, buckets, max_top_k, tp
         )
-        self._prefill = jax.jit(self._prefill_impl)
-        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        if tp is None:
+            self._param_sh = self._pool_sh = self._row_sh = None
+            self._prefill = jax.jit(self._prefill_impl)
+            self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+            self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        else:
+            validate_tp_config(self.config, tp.logical_tp)
+            example = jnp.zeros((1, 4), jnp.int32)
+            self._param_sh = param_shardings(tp, self.model, example)
+            # Abstract params (plain unboxed leaves) seed the pool/row
+            # shape harvest without ever running a forward pass.
+            import flax.linen as nn
+
+            abstract_params = jax.eval_shape(
+                lambda: nn.meta.unbox(
+                    self.model.init(jax.random.PRNGKey(0), example)[
+                        "params"
+                    ]
+                )
+            )
+            pool_struct = jax.eval_shape(
+                lambda p: self._cache_shapes(p, self.slots),
+                abstract_params,
+            )
+            row_struct = jax.eval_shape(
+                lambda p: self._cache_shapes(p, 1), abstract_params
+            )
+            self._pool_sh = tp.pool_shardings(pool_struct)
+            self._row_sh = tp.pool_shardings(row_struct)
+            rep = tp.replicated()
+            self._prefill = jax.jit(
+                self._prefill_impl,
+                in_shardings=(
+                    self._param_sh, rep, rep, rep, rep, rep
+                ),
+                out_shardings=(self._row_sh, rep, rep),
+            )
+            self._insert = jax.jit(
+                self._insert_impl,
+                donate_argnums=(0,),
+                in_shardings=(self._pool_sh, self._row_sh, rep),
+                out_shardings=self._pool_sh,
+            )
+            self._decode = jax.jit(
+                self._decode_impl,
+                donate_argnums=(1,),
+                in_shardings=(
+                    self._param_sh, self._pool_sh,
+                    rep, rep, rep, rep, rep,
+                ),
+                out_shardings=(self._pool_sh, rep, rep),
+            )
         # AOT executables: {("prefill", bucket) | ("insert",) | ("decode",)
         # -> compiled}.  Populated by aot_compile; the jit path is the
         # fallback (first call traces lazily).
         self._aot: Dict[Tuple, Any] = {}
 
+    def _trace_ctx(self):
+        """Tracing context: under TP the model's logical-axis constraints
+        need the mesh + rule table ambient (same contexts the trainer
+        traces under); without TP this is free."""
+        if self.tp is None:
+            return contextlib.nullcontext()
+        import flax.linen as nn
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(train_lib.use_mesh(self.tp.mesh))
+        stack.enter_context(nn.logical_axis_rules(SERVE_TP_RULES))
+        return stack
+
     # -- cache pool -----------------------------------------------------------
+
+    def _cache_shapes(self, params, batch: int):
+        _, mutated = self.model.apply(
+            {"params": params},
+            jnp.zeros((batch, 1), jnp.int32),
+            positions=jnp.zeros((batch, 1), jnp.int32),
+            mutable=["cache"],
+        )
+        return mutated["cache"]
 
     def init_cache(self, params) -> Any:
         """A zeroed slot-pool cache pytree ([layers, slots, max_seq, H_kv,
         hd] per K/V leaf).  ``eval_shape`` keeps this allocation-only —
-        no forward pass runs."""
-
-        def shape_of(params):
-            _, mutated = self.model.apply(
-                {"params": params},
-                jnp.zeros((self.slots, 1), jnp.int32),
-                positions=jnp.zeros((self.slots, 1), jnp.int32),
-                mutable=["cache"],
+        no forward pass runs.  Under TP the pool lands pre-sharded on its
+        heads axis."""
+        shapes = jax.eval_shape(
+            lambda p: self._cache_shapes(p, self.slots), params
+        )
+        if self.tp is None:
+            return jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes
             )
-            return mutated["cache"]
-
-        shapes = jax.eval_shape(shape_of, params)
         return jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+            lambda s, sh: jax.device_put(
+                jnp.zeros(s.shape, s.dtype), sh
+            ),
+            shapes, self._pool_sh,
+        )
+
+    # -- placement ------------------------------------------------------------
+
+    def place_params(self, params):
+        """Lay a (host or differently-placed) param tree out under the
+        programs' shardings — identity without TP.  Accepts boxed
+        (``LogicallyPartitioned``) trees straight from ``model.init``;
+        the shardings here are the serve fold's, not the boxes'."""
+        if self.tp is None:
+            return params
+        params = nn.meta.unbox(params)
+        return jax.tree.map(
+            lambda p, s: jax.device_put(p, s), params, self._param_sh
+        )
+
+    def place_row(self, row):
+        """Lay a prefilled cache row (possibly a host-numpy page streamed
+        from a prefill replica) out under the pool's sharding."""
+        if self.tp is None:
+            return row
+        return jax.tree.map(
+            lambda leaf, s: jax.device_put(jnp.asarray(leaf), s),
+            row, self._row_sh,
+        )
+
+    def pool_device_bytes(self, pool) -> int:
+        """Max per-device bytes of ``pool`` (the whole pool without TP)."""
+        if self.tp is not None:
+            return self.tp.pool_device_bytes(pool)
+        return sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(pool)
         )
 
     # -- traced programs ------------------------------------------------------
@@ -215,16 +375,19 @@ class ServePrograms:
 
     def prefill(self, params, tokens, true_len, rng, temp, topk):
         fn = self._aot.get(("prefill", tokens.shape[1]), self._prefill)
-        return fn(params, tokens, true_len, rng, temp, topk)
+        with self._trace_ctx():
+            return fn(params, tokens, true_len, rng, temp, topk)
 
     def insert(self, pool, row, slot):
         fn = self._aot.get(("insert",), self._insert)
-        return fn(pool, row, slot)
+        with self._trace_ctx():
+            return fn(pool, row, slot)
 
     def decode_step(self, params, pool, tokens, positions, rng, temps,
                     topks):
         fn = self._aot.get(("decode",), self._decode)
-        return fn(params, pool, tokens, positions, rng, temps, topks)
+        with self._trace_ctx():
+            return fn(params, pool, tokens, positions, rng, temps, topks)
 
     # -- AOT warm-start -------------------------------------------------------
 
@@ -239,43 +402,246 @@ class ServePrograms:
         one = jnp.ones((1,), jnp.float32)
         one_k = jnp.zeros((1,), jnp.int32)
         cache = None
-        for bucket in self.buckets:
-            key = ("prefill", bucket)
-            if key in self._aot:
-                continue
-            self._aot[key] = self._prefill.lower(
-                params, jnp.zeros((1, bucket), jnp.int32),
-                jnp.int32(bucket), rng, one, one_k,
-            ).compile()
-            compiled_any = True
-        if ("insert",) not in self._aot or ("decode",) not in self._aot:
-            cache = self.init_cache(params)
-        if ("insert",) not in self._aot:
-            # The batch-1 cache row a prefill produces: slot axis sliced
-            # to width 1, per-layer scalars kept as-is.
-            row = jax.tree.map(
-                lambda leaf: leaf[:, :1] if leaf.ndim >= 2 else leaf,
-                cache,
+        with self._trace_ctx():
+            for bucket in self.buckets:
+                key = ("prefill", bucket)
+                if key in self._aot:
+                    continue
+                self._aot[key] = self._prefill.lower(
+                    params, jnp.zeros((1, bucket), jnp.int32),
+                    jnp.int32(bucket), rng, one, one_k,
+                ).compile()
+                compiled_any = True
+            if ("insert",) not in self._aot or ("decode",) not in self._aot:
+                cache = self.init_cache(params)
+            if ("insert",) not in self._aot:
+                # The batch-1 cache row a prefill produces: slot axis
+                # sliced to width 1, per-layer scalars kept as-is.
+                row = jax.tree.map(
+                    lambda leaf: leaf[:, :1] if leaf.ndim >= 2 else leaf,
+                    cache,
+                )
+                row = self.place_row(row)
+                self._aot[("insert",)] = self._insert.lower(
+                    cache, row, jnp.int32(0)
+                ).compile()
+                compiled_any = True
+            if ("decode",) not in self._aot:
+                s = self.slots
+                self._aot[("decode",)] = self._decode.lower(
+                    params, cache,
+                    jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+                    rng, jnp.ones((s,), jnp.float32),
+                    jnp.zeros((s,), jnp.int32),
+                ).compile()
+                compiled_any = True
+        return time.perf_counter() - t0 if compiled_any else 0.0
+
+
+class SpecPrograms:
+    """Speculative-decoding pair over two :class:`ServePrograms`:
+
+    * ``propose(draft_params, draft_pool, tokens[S], positions[S])`` —
+      the draft model greedily rolls γ tokens per slot inside ONE jitted
+      ``lax.scan`` program (γ sequential draft steps, one dispatch),
+      writing the draft's own KV pool as it goes.
+    * ``verify(params, pool, chunk[S, γ+1], positions[S], rng, temps,
+      topks)`` — the target model scores the whole chunk (current token
+      + γ proposals) in one decode-mode apply; per slot the accepted
+      length is the longest prefix where the draft matched the target's
+      greedy argmax, plus one BONUS token from the target's own logits
+      at the first divergence — so every verify emits ``n+1 ∈ [1, γ+1]``
+      tokens and a greedy slot's token stream is bitwise the plain
+      decode path's (lossless speculation).  Sampled rows (temp > 0)
+      force ``n = 0`` and draw the bonus through the same
+      ``sample_tokens`` contract as plain decode — speculation never
+      changes a sampled distribution.
+
+    Cache hygiene: verify writes K/V for all γ+1 chunk positions, but
+    rejected positions are causally inert — ``cached_attention`` masks
+    ``kpos <= q_position`` and the committed stream's next writes land
+    exactly on (and overwrite) the stale rows, the same argument that
+    makes prefill right-padding safe (serving/bucketing.py).
+    """
+
+    def __init__(
+        self,
+        target: ServePrograms,
+        draft: ServePrograms,
+        spec_tokens: int,
+    ):
+        if not 1 <= spec_tokens <= MAX_SPEC_TOKENS:
+            raise ValueError(
+                f"spec_tokens must be in [1, {MAX_SPEC_TOKENS}], got "
+                f"{spec_tokens} (the γ+1-wide verify chunk must stay "
+                "under the flash prefill threshold)"
             )
-            self._aot[("insert",)] = self._insert.lower(
-                cache, row, jnp.int32(0)
-            ).compile()
-            compiled_any = True
-        if ("decode",) not in self._aot:
-            s = self.slots
-            self._aot[("decode",)] = self._decode.lower(
-                params, cache,
-                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
-                rng, jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
-            ).compile()
-            compiled_any = True
+        if target.config.vocab_size != draft.config.vocab_size:
+            raise ValueError(
+                "draft and target must share a vocab: "
+                f"{draft.config.vocab_size} != {target.config.vocab_size}"
+            )
+        if target.slots != draft.slots:
+            raise ValueError(
+                f"draft slots {draft.slots} != target slots {target.slots}"
+            )
+        t_tp = (target.tp.logical_tp, target.tp.physical_tp) \
+            if target.tp else ()
+        d_tp = (draft.tp.logical_tp, draft.tp.physical_tp) \
+            if draft.tp else ()
+        if t_tp != d_tp:
+            raise ValueError(
+                f"draft tp {d_tp} != target tp {t_tp}: the draft shares "
+                "the TP decode path"
+            )
+        self.target = target
+        self.draft = draft
+        self.spec_tokens = spec_tokens
+        self.cache_key = repr(
+            ("spec", target.cache_key, draft.cache_key, spec_tokens)
+        )
+        if target.tp is None:
+            self._propose = jax.jit(
+                self._propose_impl, donate_argnums=(1,)
+            )
+            self._verify = jax.jit(
+                self._verify_impl, donate_argnums=(1,)
+            )
+        else:
+            rep = target.tp.replicated()
+            self._propose = jax.jit(
+                self._propose_impl,
+                donate_argnums=(1,),
+                in_shardings=(
+                    draft._param_sh, draft._pool_sh, rep, rep
+                ),
+                out_shardings=(draft._pool_sh, rep),
+            )
+            self._verify = jax.jit(
+                self._verify_impl,
+                donate_argnums=(1,),
+                in_shardings=(
+                    target._param_sh, target._pool_sh,
+                    rep, rep, rep, rep, rep,
+                ),
+                out_shardings=(
+                    target._pool_sh, rep, rep, rep, rep
+                ),
+            )
+        self._aot: Dict[Tuple, Any] = {}
+
+    def _propose_impl(self, draft_params, draft_pool, tokens, positions):
+        train_lib.TRACE_COUNTS["serve_draft"] += 1
+
+        def body(carry, _):
+            pool, tok, pos = carry
+            (logits, _), mutated = self.draft.model.apply(
+                {"params": draft_params, "cache": pool},
+                tok[:, None],
+                positions=pos[:, None],
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(
+                logits[:, 0].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            return (mutated["cache"], nxt, pos + 1), nxt
+
+        (pool, _, _), proposed = jax.lax.scan(
+            body, (draft_pool, tokens, positions), None,
+            length=self.spec_tokens,
+        )
+        return pool, jnp.transpose(proposed)  # [S, γ]
+
+    def _verify_impl(self, params, pool, chunk, positions, rng, temps,
+                     topks):
+        train_lib.TRACE_COUNTS["serve_verify"] += 1
+        s, width = chunk.shape  # width == γ + 1
+        pos_grid = positions[:, None] + jnp.arange(width)[None, :]
+        (logits, _), mutated = self.target.model.apply(
+            {"params": params, "cache": pool},
+            chunk,
+            positions=pos_grid,
+            mutable=["cache"],
+        )
+        logits32 = logits.astype(jnp.float32)
+        target_greedy = jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+        proposals = chunk[:, 1:]  # [S, γ]
+        match = (proposals == target_greedy[:, :-1]).astype(jnp.int32)
+        # Longest matching prefix: cumprod kills everything after the
+        # first mismatch.
+        accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [S]
+        greedy_row = temps <= 0.0
+        accepted = jnp.where(greedy_row, accepted, 0)
+        # The bonus token at the first divergence: the target's own
+        # prediction for greedy rows, a real sample (same contract as
+        # plain decode) for temp>0 rows — whose divergence point is
+        # always chunk position 0.
+        sampled0, _ = sample_tokens(
+            logits32[:, 0], rng, temps, topks, self.target.max_top_k
+        )
+        bonus = jnp.take_along_axis(
+            target_greedy, accepted[:, None], axis=1
+        )[:, 0]
+        bonus = jnp.where(greedy_row, bonus, sampled0)
+        # emitted[i] = proposals[i] for i < n, bonus at i == n (host
+        # reads emit_len = n+1 tokens; beyond that is junk).
+        idx = jnp.arange(width)[None, :]
+        prop_pad = jnp.concatenate(
+            [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1
+        )
+        emitted = jnp.where(
+            idx < accepted[:, None], prop_pad, bonus[:, None]
+        )
+        logp_all = jax.nn.log_softmax(logits32, axis=-1)
+        logps = jnp.take_along_axis(
+            logp_all, emitted[..., None], axis=-1
+        )[..., 0]
+        emit_len = accepted + 1
+        return mutated["cache"], emitted, emit_len, logps, accepted
+
+    # -- dispatch -------------------------------------------------------------
+
+    def propose(self, draft_params, draft_pool, tokens, positions):
+        fn = self._aot.get(("propose",), self._propose)
+        with self.target._trace_ctx():
+            return fn(draft_params, draft_pool, tokens, positions)
+
+    def verify(self, params, pool, chunk, positions, rng, temps, topks):
+        fn = self._aot.get(("verify",), self._verify)
+        with self.target._trace_ctx():
+            return fn(params, pool, chunk, positions, rng, temps, topks)
+
+    # -- AOT warm-start -------------------------------------------------------
+
+    def aot_compile(self, params, draft_params) -> float:
+        t0 = time.perf_counter()
+        compiled_any = False
+        s = self.target.slots
+        tok = jnp.zeros((s,), jnp.int32)
+        with self.target._trace_ctx():
+            if ("propose",) not in self._aot:
+                draft_pool = self.draft.init_cache(draft_params)
+                self._aot[("propose",)] = self._propose.lower(
+                    draft_params, draft_pool, tok, tok
+                ).compile()
+                compiled_any = True
+            if ("verify",) not in self._aot:
+                pool = self.target.init_cache(params)
+                self._aot[("verify",)] = self._verify.lower(
+                    params, pool,
+                    jnp.zeros((s, self.spec_tokens + 1), jnp.int32), tok,
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((s,), jnp.float32), tok,
+                ).compile()
+                compiled_any = True
         return time.perf_counter() - t0 if compiled_any else 0.0
 
 
 # Process-wide program memo: equal serve keys share traced jit programs
 # AND their AOT executables, so a rebuilt engine (elastic restart to the
-# same shape, or the bench's warm-start leg) pays zero trace/compile.
-_PROGRAMS: Dict[str, ServePrograms] = {}
+# same shape, a TP re-fold back to a seen width, or the bench's
+# warm-start leg) pays zero trace/compile.
+_PROGRAMS: Dict[str, Any] = {}
 
 
 def get_programs(
@@ -283,14 +649,25 @@ def get_programs(
     slots: int,
     buckets: Tuple[int, ...],
     max_top_k: int = 64,
+    tp: Optional[ServeTPMesh] = None,
 ) -> ServePrograms:
-    key = serve_cache_key(
-        config, slots=slots, buckets=tuple(sorted(buckets)),
-        max_top_k=max_top_k,
-    )
+    key = _programs_key(config, slots, tuple(buckets), max_top_k, tp)
     programs = _PROGRAMS.get(key)
     if programs is None:
-        programs = ServePrograms(config, slots, buckets, max_top_k)
+        programs = ServePrograms(config, slots, buckets, max_top_k, tp)
+        _PROGRAMS[key] = programs
+    return programs
+
+
+def get_spec_programs(
+    target: ServePrograms,
+    draft: ServePrograms,
+    spec_tokens: int,
+) -> SpecPrograms:
+    key = repr(("spec", target.cache_key, draft.cache_key, spec_tokens))
+    programs = _PROGRAMS.get(key)
+    if programs is None:
+        programs = SpecPrograms(target, draft, spec_tokens)
         _PROGRAMS[key] = programs
     return programs
 

@@ -24,6 +24,13 @@ failure machinery a single engine lacks:
   :meth:`maybe_scale`) moves a victim's queue to survivors, lets its live
   slots finish, and only then retires it; requests never die with a
   planned shrink.
+* **disaggregated prefill transport** — replicas carry their engine's
+  ``role``; prompts route only to prefill-capable replicas, and every
+  fleet tick streams finished :class:`~dlrover_tpu.serving.engine.
+  PrefilledPage` s from prefill outboxes to the least-loaded
+  decode-capable replica (re-assigning ownership, so failover debts
+  follow the page).  A page with no live decode target simply waits in
+  its outbox — the next tick retries.
 """
 
 from __future__ import annotations
@@ -64,6 +71,7 @@ class ReplicaFleet:
         breaker_reset_s: float = 10.0,
         min_replicas: int = 1,
         spawn: Optional[Callable[[], ServingEngine]] = None,
+        spawn_prefill: Optional[Callable[[], ServingEngine]] = None,
         clock: Callable[[], float] = time.monotonic,
         retire_hook: Optional[Callable[[str], None]] = None,
     ):
@@ -72,9 +80,13 @@ class ReplicaFleet:
         self.breaker_threshold = breaker_threshold
         self.breaker_reset_s = breaker_reset_s
         self.min_replicas = max(1, min_replicas)
-        # Optional factory for scale-out (in-process replicas share the
+        # Optional factories for scale-out (in-process replicas share the
         # compiled-program memo, so spawning is slot-pool cost only).
+        # ``spawn`` grows the decode-capable pool; ``spawn_prefill`` grows
+        # the prefill pool of a disaggregated fleet — the two pools scale
+        # on independent signals (latency/occupancy vs prompt backlog).
         self.spawn = spawn
+        self.spawn_prefill = spawn_prefill
         self._replicas: Dict[str, _Replica] = {}
         self._counter = 0
         # uid -> rid of the replica currently responsible for it.
@@ -89,6 +101,8 @@ class ReplicaFleet:
         self.deaths = 0
         self.resubmitted = 0
         self.retired = 0
+        self.pages_streamed = 0
+        self.page_bytes_streamed = 0
         # Called with the rid after ANY registry exit (drain or kill) —
         # the master wires observability eviction here so retired
         # replicas drop their timeline/serve-ledger series like retired
@@ -146,21 +160,30 @@ class ReplicaFleet:
 
     # -- routing --------------------------------------------------------------
 
+    @staticmethod
+    def _role(replica: _Replica) -> str:
+        return getattr(replica.engine, "role", "mixed")
+
     def _load(self, replica: _Replica) -> int:
         engine = replica.engine
-        return len(engine._queue) + len(engine._live_slots())
+        return (
+            len(engine._queue) + len(engine._live_slots())
+            + len(getattr(engine, "_page_queue", ()))
+        )
 
     def submit(self, request: Request) -> str:
-        """Dispatch to the least-loaded routable replica; returns its rid.
-        Raises :class:`NoReplicaError` when nothing is routable and
-        ``ValueError`` (from the engine) for never-admissible requests."""
+        """Dispatch to the least-loaded prefill-capable routable replica;
+        returns its rid.  Raises :class:`NoReplicaError` when nothing is
+        routable and ``ValueError`` (from the engine) for
+        never-admissible requests."""
         candidates = [
             r for rid, r in sorted(self._replicas.items())
-            if self.routable(rid)
+            if self.routable(rid) and self._role(r) != "decode"
         ]
         if not candidates:
             raise NoReplicaError(
-                f"no routable replica among {self.replica_ids()}"
+                f"no routable prefill-capable replica among "
+                f"{self.replica_ids()}"
             )
         replica = min(candidates, key=self._load)
         replica.engine.submit(request)
@@ -194,7 +217,46 @@ class ReplicaFleet:
             replica.breaker.record_success()
             replica.last_seen = self._clock()
             self._harvest(replica)
+        self._stream_pages()
         return decoded
+
+    # -- disaggregated page transport -----------------------------------------
+
+    def _decode_target(self) -> Optional[_Replica]:
+        """Least-loaded routable decode-capable replica, or None."""
+        candidates = [
+            r for rid, r in sorted(self._replicas.items())
+            if self.routable(rid) and self._role(r) != "prefill"
+        ]
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda r: len(r.engine._live_slots())
+            + len(getattr(r.engine, "_page_queue", ())),
+        )
+
+    def _stream_pages(self) -> int:
+        """Move finished pages from prefill outboxes to decode replicas.
+        Ownership (``_assigned``) follows the page, so a decode-replica
+        death resubmits the request from the retained original — the
+        page itself is never the source of truth."""
+        moved = 0
+        for replica in list(self._replicas.values()):
+            outbox = getattr(replica.engine, "outbox", None)
+            if not outbox:
+                continue
+            while outbox:
+                target = self._decode_target()
+                if target is None:
+                    break  # nothing decode-capable right now; retry later
+                page = outbox.popleft()
+                target.engine.insert_page(page)
+                self._assigned[page.request.uid] = target.rid
+                self.pages_streamed += 1
+                self.page_bytes_streamed += page.nbytes
+                moved += 1
+        return moved
 
     def _harvest(self, replica: _Replica):
         for uid, result in replica.engine.results.items():
@@ -305,12 +367,28 @@ class ReplicaFleet:
                 f"cannot drain {rid}: fleet at min_replicas="
                 f"{self.min_replicas}"
             )
+        # Flush any finished pages out before the replica stops routing.
+        self._stream_pages()
         replica.draining = True
         # Requeue its waiting requests on the survivors.
         queue = replica.engine._queue
         while queue:
             request, _ = queue.popleft()
             self.submit(request)
+        # Hand its undelivered pages to another decode-capable replica
+        # (a draining replica is unroutable, so _decode_target skips it).
+        pages = getattr(replica.engine, "_page_queue", None)
+        while pages:
+            target = self._decode_target()
+            if target is None:
+                replica.draining = False
+                raise NoReplicaError(
+                    f"cannot drain {rid}: no decode-capable survivor for "
+                    f"its {len(pages)} pending page(s)"
+                )
+            page = pages.popleft()
+            target.engine.insert_page(page)
+            self._assigned[page.request.uid] = target.rid
         # Let live slots run dry — the whole fleet keeps stepping, so the
         # drain is invisible to every other replica's traffic.
         for _ in range(max_steps):
@@ -330,12 +408,30 @@ class ReplicaFleet:
         aggregate (the in-process analogue of the auto-scaler's
         ``observe_serving``): hot → spawn a replica (when a ``spawn``
         factory is wired), comfortably idle → drain-then-retire the
-        least-loaded one.  Returns "out", "in" or None."""
+        least-loaded one.  Returns "out", "in" or None.
+
+        Two refinements over the raw thresholds: a p95 backed by fewer
+        than ``policy.min_samples`` completed requests is IGNORED (a
+        quantile over two latencies is noise, and acting on it flaps the
+        fleet — occupancy, which is always well-sampled, still acts); and
+        a disaggregated fleet's prefill pool scales on its own signal —
+        prompt backlog per prefill replica against
+        ``policy.prefill_backlog_high`` — independent of the decode
+        pool's latency/occupancy, because a prefill bottleneck shows up
+        as queueing long before it moves decode p95."""
         stats = self.stats()
         if stats["replicas"] < 1 or stats["qps"] < policy.min_qps:
             return None
+        min_samples = int(getattr(policy, "min_samples", 0))
+        p95_known = stats.get("p95_n", float("inf")) >= min_samples
+        n_prefill = stats.get("prefill_replicas", 0.0)
+        if n_prefill and self.spawn_prefill is not None:
+            backlog = stats.get("prefill_backlog", 0.0) / n_prefill
+            if backlog > float(getattr(policy, "prefill_backlog_high", 4.0)):
+                self.add_replica(self.spawn_prefill())
+                return "out"
         if (
-            stats["p95_s"] > policy.slo_p95_s
+            (p95_known and stats["p95_s"] > policy.slo_p95_s)
             or stats["occupancy"] > policy.occupancy_high
         ):
             if self.spawn is not None:
@@ -343,14 +439,18 @@ class ReplicaFleet:
                 return "out"
             return None
         if (
-            stats["p95_s"] < 0.5 * policy.slo_p95_s
+            p95_known
+            and stats["p95_s"] < 0.5 * policy.slo_p95_s
             and stats["occupancy"] < policy.occupancy_low
             and len(self._replicas) > self.min_replicas
         ):
-            victim = min(
-                (r for r in self._replicas.values()),
-                key=self._load,
-            )
+            # Retire from the decode-capable pool when one exists —
+            # idle occupancy is a decode-side signal.
+            pool = [
+                r for r in self._replicas.values()
+                if self._role(r) != "prefill"
+            ] or list(self._replicas.values())
+            victim = min(pool, key=self._load)
             self.drain(victim.rid)
             return "in"
         return None
@@ -379,10 +479,27 @@ class ReplicaFleet:
     def stats(self) -> Dict[str, float]:
         per = [r.engine.stats() for r in self._replicas.values()]
         n = len(per)
+        # The fleet p95 is the WORST replica's; its sample count rides
+        # along so the scale policy can judge whether that p95 means
+        # anything (new keys use .get so older/stubbed engines compose).
+        worst = max(
+            per, key=lambda s: s["p95_s"], default={"p95_s": 0.0}
+        )
+        prefill = [
+            r for r in self._replicas.values()
+            if self._role(r) == "prefill"
+        ]
+        spec_prop = sum(s.get("spec_proposed", 0.0) for s in per)
+        spec_acc = sum(s.get("spec_accepted", 0.0) for s in per)
         return {
             "replicas": float(n),
             "qps": sum(s["qps"] for s in per),
-            "p95_s": max((s["p95_s"] for s in per), default=0.0),
+            "p95_s": worst["p95_s"] if per else 0.0,
+            "p95_n": worst.get("p95_n", 0.0) if per else 0.0,
+            "decode_step_p95_s": max(
+                (s.get("decode_step_p95_s", 0.0) for s in per),
+                default=0.0,
+            ),
             "occupancy": (
                 sum(s["occupancy"] for s in per) / n if n else 0.0
             ),
@@ -392,4 +509,16 @@ class ReplicaFleet:
             "deaths": float(self.deaths),
             "resubmitted": float(self.resubmitted),
             "retired": float(self.retired),
+            "prefill_replicas": float(len(prefill)),
+            "decode_replicas": float(n - len(prefill)),
+            "prefill_backlog": float(sum(
+                len(r.engine._queue) for r in prefill
+            )),
+            "pages_streamed": float(self.pages_streamed),
+            "page_bytes_streamed": float(self.page_bytes_streamed),
+            "spec_proposed": spec_prop,
+            "spec_accepted": spec_acc,
+            "spec_accept_rate": (
+                spec_acc / spec_prop if spec_prop else 0.0
+            ),
         }

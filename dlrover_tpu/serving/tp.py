@@ -1,0 +1,230 @@
+"""Serve-side tensor parallelism: one logical model folded across devices.
+
+The serving analogue of :class:`dlrover_tpu.runtime.virtual_mesh.VirtualMesh`,
+specialized to the ``tensor`` ("model") axis: the *logical* TP width is
+fixed when the fleet is sized (it names the compiled program FAMILY via
+``serve_cache_key``'s ``tp`` bit, exactly like ``train_cache_key`` carries
+``logical_shape``), and a fleet resize only changes the *physical* fold —
+how many devices the logical shards currently land on.  Folding back to a
+previously-seen physical width is a memo hit on already-traced programs:
+zero retrace, zero recompile (asserted by the resize-mid-serve test).
+
+Mechanism: GSPMD, not hand-written collectives.  The models already
+annotate every parameter and activation with logical axis names
+(``parallel/rules.py``); serving TP is therefore a *rule table* —
+Megatron-style column/row splits —
+
+* attention QKV + MLP wi/wg: column-split (``heads``/``mlp`` -> tensor);
+* attention out + MLP wo: row-split (same names on the contracting dim),
+  XLA inserts the single psum at each block seam;
+* vocab (embedding + tied logits): vocab-split, XLA masks the gather and
+  psums the attend;
+* activations at block boundaries: REPLICATED (``act_embed -> None``),
+  unlike the training table's SP-style ``act_embed -> tensor`` — a decode
+  step's [slots, 1, d] residual is far too small to shard profitably and
+  replication keeps the psum count to the two Megatron seams per layer.
+
+The paged KV pool shards with the model: each K/V leaf
+``[layers, slots, max_seq, H_kv, hd]`` splits on its ``H_kv`` axis, so
+per-device pool bytes fall as 1/tp — the "model > 1-host-HBM" capacity
+story ``tools/serve_bench.py --tp-drill`` measures from addressable
+shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from dlrover_tpu.parallel import rules as lr
+from dlrover_tpu.runtime.mesh import MESH_AXES, TENSOR_AXIS
+
+#: The serving TP rule table (see module docstring): params split
+#: Megatron-style over the ``tensor`` axis, activations replicated at
+#: block boundaries, heads sharded inside attention.
+SERVE_TP_RULES: List[Tuple[str, Any]] = [
+    (lr.BATCH, None),
+    (lr.ACT_SEQ, None),
+    (lr.ACT_EMBED, None),
+    (lr.ACT_HEADS, TENSOR_AXIS),
+    (lr.EMBED, None),
+    (lr.KV, None),
+    (lr.NORM, None),
+    (lr.GATHERED, None),
+    (lr.MLP, TENSOR_AXIS),
+    (lr.HEADS, TENSOR_AXIS),
+    (lr.VOCAB, TENSOR_AXIS),
+    (lr.EXPERT, None),
+    (lr.STAGES, None),
+    (lr.LAYERS, None),
+]
+
+
+def fold_width(logical_tp: int, available: int) -> int:
+    """Largest divisor of ``logical_tp`` that fits in ``available``
+    devices — the fold rule for the serve TP axis.  Divisibility keeps
+    every head shard whole on exactly one device (the analogue of
+    ``virtual_mesh.shard_owner`` keeping submeshes host-granular)."""
+    if logical_tp < 1 or available < 1:
+        raise ValueError(
+            f"logical_tp and available must be >= 1, got "
+            f"{logical_tp}/{available}"
+        )
+    for width in range(min(logical_tp, available), 0, -1):
+        if logical_tp % width == 0:
+            return width
+    return 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeTPMesh:
+    """A fixed logical TP width currently folded onto ``physical_tp``
+    devices (``mesh``'s tensor axis).  Immutable; :meth:`fold_to` returns
+    the re-folded view a fleet resize swaps in."""
+
+    mesh: Mesh
+    logical_tp: int
+    physical_tp: int
+
+    def __post_init__(self):
+        if self.logical_tp < 1 or self.physical_tp < 1:
+            raise ValueError(
+                f"tp widths must be >= 1, got logical={self.logical_tp} "
+                f"physical={self.physical_tp}"
+            )
+        if self.logical_tp % self.physical_tp:
+            raise ValueError(
+                f"physical_tp {self.physical_tp} must divide logical_tp "
+                f"{self.logical_tp} (head shards stay device-whole)"
+            )
+
+    @property
+    def fold(self) -> int:
+        """Logical head-shards per device at the current fold."""
+        return self.logical_tp // self.physical_tp
+
+    @property
+    def logical_shape(self) -> Tuple[int, ...]:
+        """The resize-invariant program-family shape: the mesh with its
+        tensor axis scaled back to the logical width."""
+        shape = list(self.mesh.devices.shape)
+        shape[MESH_AXES.index(TENSOR_AXIS)] = self.logical_tp
+        return tuple(shape)
+
+    def fold_to(self, physical_tp: int) -> "ServeTPMesh":
+        """The same logical model folded onto ``physical_tp`` devices."""
+        return build_tp_mesh(self.logical_tp, physical_tp)
+
+    # -- shardings -------------------------------------------------------------
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def pool_sharding(self, leaf) -> NamedSharding:
+        """Sharding for one KV-pool (or prefilled-row) leaf: K/V tensors
+        ``[layers, slots|1, seq, H_kv, hd]`` split on the heads axis;
+        low-rank leaves (the per-layer ``cache_index`` scalars) replicate.
+        """
+        ndim = getattr(leaf, "ndim", np.ndim(leaf))
+        if ndim >= 4:
+            spec = [None] * ndim
+            spec[ndim - 2] = TENSOR_AXIS
+            return NamedSharding(self.mesh, P(*spec))
+        return self.replicated()
+
+    def pool_shardings(self, pool) -> Any:
+        return jax.tree.map(self.pool_sharding, pool)
+
+    def place(self, tree, shardings):
+        """``device_put`` a (host or differently-laid-out) pytree under
+        ``shardings`` — the relayout step of a TP fold."""
+        return jax.tree.map(
+            lambda leaf, s: jax.device_put(leaf, s), tree, shardings
+        )
+
+    def pool_device_bytes(self, pool) -> int:
+        """MAX per-device bytes of the pool — the capacity number the
+        ``--tp-drill`` measures (∝ 1/tp when the heads axis shards)."""
+        per_device: dict = {}
+        for leaf in jax.tree.leaves(pool):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is None:
+                continue
+            for shard in shards:
+                did = shard.device.id
+                per_device[did] = (
+                    per_device.get(did, 0) + shard.data.nbytes
+                )
+        return max(per_device.values(), default=0)
+
+
+def build_tp_mesh(
+    logical_tp: int,
+    physical_tp: Optional[int] = None,
+    devices: Optional[List[jax.Device]] = None,
+) -> ServeTPMesh:
+    """Build the serve TP mesh: a 6-axis mesh (same axis names as
+    training, so the rule table composes) whose ``tensor`` axis spans
+    ``physical_tp`` devices.  ``physical_tp=None`` folds the logical
+    width onto however many devices exist (:func:`fold_width`)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if physical_tp is None:
+        physical_tp = fold_width(logical_tp, len(devices))
+    if physical_tp > len(devices):
+        raise ValueError(
+            f"physical_tp {physical_tp} exceeds the {len(devices)} "
+            f"visible devices"
+        )
+    shape = [1] * len(MESH_AXES)
+    shape[MESH_AXES.index(TENSOR_AXIS)] = physical_tp
+    mesh = Mesh(
+        np.asarray(devices[:physical_tp]).reshape(shape), MESH_AXES
+    )
+    return ServeTPMesh(
+        mesh=mesh, logical_tp=logical_tp, physical_tp=physical_tp
+    )
+
+
+def validate_tp_config(config, logical_tp: int) -> None:
+    """TP width must divide the head counts (Q heads for the projections,
+    KV heads for the pool's shard axis) and the vocab (embedding split).
+    Raises ``ValueError`` with the failing dimension named."""
+    kv_heads = config.num_kv_heads or config.num_heads
+    for name, size in (
+        ("num_heads", config.num_heads),
+        ("num_kv_heads", kv_heads),
+        ("vocab_size", config.vocab_size),
+        ("d_ff", config.resolved_d_ff),
+    ):
+        if size % logical_tp:
+            raise ValueError(
+                f"tp={logical_tp} must divide {name}={size}"
+            )
+
+
+def param_shardings(tp: ServeTPMesh, model, example_tokens) -> Any:
+    """Harvest per-param NamedShardings from the model's logical
+    annotations under :data:`SERVE_TP_RULES` — the same eval_shape →
+    get_partition_spec → logical_to_mesh_sharding chain the trainer
+    uses, so serving TP rides the exact annotations training shards by.
+    """
+    import flax.linen as nn
+
+    from dlrover_tpu.trainer.train_lib import _sanitize_boxes, use_mesh
+
+    with use_mesh(tp.mesh), nn.logical_axis_rules(SERVE_TP_RULES):
+        abstract = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), example_tokens)[
+                "params"
+            ]
+        )
+        abstract = _sanitize_boxes(abstract)
+        logical_specs = nn.get_partition_spec(abstract)
+        return nn.logical_to_mesh_sharding(
+            logical_specs, tp.mesh, SERVE_TP_RULES
+        )

@@ -484,10 +484,12 @@ def test_engine_telemetry_event_shape(setup):
     attrs = dict(serve_events[-1][4])
     attrs.pop("src", None)  # stamped by the recorder, not the engine
     assert set(attrs) == {
-        "qps", "p50_s", "p95_s", "occupancy", "slots", "requests",
-        "tokens",
+        "qps", "p50_s", "p95_s", "p95_n", "occupancy", "slots",
+        "requests", "tokens", "spec_accept_rate", "spec_proposed",
+        "spec_accepted", "decode_step_p95_s",
     }
     assert attrs["requests"] == 1 and attrs["tokens"] == 3
+    assert attrs["p95_n"] == 1  # one completed request backs the p95
 
     from dlrover_tpu.master.speed_monitor import SpeedMonitor
 

@@ -77,6 +77,10 @@ def test_serve_bench_help(cpu_child_env):
     assert "--replicas" in out.stdout and "--max-pending" in out.stdout
     assert "--deadline-s" in out.stdout and "--slo-p95-s" in out.stdout
     assert "--kill-tick" in out.stdout and "--shed-budget-s" in out.stdout
+    # The tensor-parallel serving drill rides the same tool.
+    assert "--tp-drill" in out.stdout and "--tp-widths" in out.stdout
+    assert "--spec-tokens" in out.stdout and "--draft-layers" in out.stdout
+    assert "--draft-damp" in out.stdout and "--accept-floor" in out.stdout
 
 
 def test_tracelint_json_smoke(tmp_path, cpu_child_env):
@@ -207,6 +211,121 @@ def test_serve_fleet_gate_predicate():
     breached = dict(drill, p95_post_death_s=2.0)
     ok, failed = tool.evaluate_fleet_gate(breached)
     assert not ok and failed == ["p95_recovered_under_slo"]
+
+
+def _tp_drill_fixture():
+    def leg(tp, kv, flops, dbound):
+        return {
+            "tp": tp, "completed": True, "greedy_parity": True,
+            "kv_device_bytes": kv, "device_flops_per_step": flops,
+            "device_bound_tokens_per_s": dbound, "steady_retraces": 0,
+        }
+
+    return {
+        "tp_legs": [
+            leg(1, 32776, 228309.0, 1200.0),
+            leg(2, 16392, 121313.0, 2258.0),
+            leg(4, 8200, 67815.0, 4040.0),
+        ],
+        "disagg": {
+            "requests": 24, "completed": True, "lost": 0,
+            "pages_streamed": 24, "decode_step_p95_s": 0.004,
+            "colocated_decode_step_p95_s": 0.009,
+        },
+        "spec": {
+            "accept_rate": 0.82, "accept_floor": 0.6,
+            "tokens_per_s": 900.0, "plain_tokens_per_s": 600.0,
+            "greedy_parity": True,
+        },
+        "resize": {"completed": True, "warm_fold_retraces": 0},
+    }
+
+
+def test_serve_tp_gate_predicate():
+    """The --tp-drill ok gate is a pure predicate over the drill dict:
+    each TP/disagg/spec/resize invariant fails as its own named check."""
+    tool = _load_module(
+        os.path.join(REPO, "tools", "serve_bench.py"), "_serve_bench"
+    )
+    drill = _tp_drill_fixture()
+    ok, failed = tool.evaluate_tp_gate(drill)
+    assert ok and failed == []
+
+    divergent = _tp_drill_fixture()
+    divergent["tp_legs"][2]["greedy_parity"] = False
+    ok, failed = tool.evaluate_tp_gate(divergent)
+    assert not ok and failed == ["tp_greedy_parity"]
+
+    unsharded = _tp_drill_fixture()
+    unsharded["tp_legs"][2]["kv_device_bytes"] = 32776
+    ok, failed = tool.evaluate_tp_gate(unsharded)
+    assert not ok
+    assert "tp_device_scaling_monotonic" in failed
+    assert "tp_kv_bytes_near_ideal" in failed
+
+    retraced = _tp_drill_fixture()
+    retraced["tp_legs"][1]["steady_retraces"] = 3
+    ok, failed = tool.evaluate_tp_gate(retraced)
+    assert not ok and failed == ["tp_zero_steady_retrace"]
+
+    lossy = _tp_drill_fixture()
+    lossy["disagg"]["lost"] = 1
+    ok, failed = tool.evaluate_tp_gate(lossy)
+    assert not ok and failed == ["disagg_zero_lost"]
+
+    unstreamed = _tp_drill_fixture()
+    unstreamed["disagg"]["pages_streamed"] = 0
+    ok, failed = tool.evaluate_tp_gate(unstreamed)
+    assert not ok and failed == ["disagg_pages_streamed"]
+
+    bubbled = _tp_drill_fixture()
+    bubbled["disagg"]["decode_step_p95_s"] = 0.02
+    ok, failed = tool.evaluate_tp_gate(bubbled)
+    assert not ok and failed == ["disagg_decode_p95_wins"]
+
+    rejected = _tp_drill_fixture()
+    rejected["spec"]["accept_rate"] = 0.3
+    ok, failed = tool.evaluate_tp_gate(rejected)
+    assert not ok and failed == ["spec_acceptance_floor"]
+
+    slower = _tp_drill_fixture()
+    slower["spec"]["tokens_per_s"] = 500.0
+    ok, failed = tool.evaluate_tp_gate(slower)
+    assert not ok and failed == ["spec_throughput_wins"]
+
+    drifted = _tp_drill_fixture()
+    drifted["spec"]["greedy_parity"] = False
+    ok, failed = tool.evaluate_tp_gate(drifted)
+    assert not ok and failed == ["spec_greedy_parity"]
+
+    refolded = _tp_drill_fixture()
+    refolded["resize"]["warm_fold_retraces"] = 2
+    ok, failed = tool.evaluate_tp_gate(refolded)
+    assert not ok and failed == ["resize_zero_retrace"]
+
+
+def test_serve_tp_json_artifact_certified():
+    """The committed SERVE_TP.json must be a real certified run: gate
+    re-evaluates to ok on the booked numbers, the per-device decode cost
+    shrinks with tp, and the greedy streams match across widths."""
+    path = os.path.join(REPO, "SERVE_TP.json")
+    with open(path) as f:
+        result = json.load(f)
+    tool = _load_module(
+        os.path.join(REPO, "tools", "serve_bench.py"), "_serve_bench2"
+    )
+    detail = result["detail"]
+    ok, failed = tool.evaluate_tp_gate(detail)
+    assert ok, f"SERVE_TP.json fails its own gate: {failed}"
+    assert detail["ok"] is True
+    legs = detail["tp_legs"]
+    assert len(legs) >= 2 and legs[0]["tp"] == 1
+    assert all(leg["greedy_parity"] for leg in legs)
+    assert (
+        legs[-1]["device_flops_per_step"]
+        < legs[0]["device_flops_per_step"]
+    )
+    assert detail["spec"]["accept_rate"] >= detail["spec"]["accept_floor"]
 
 
 def test_embed_bench_help(cpu_child_env):

@@ -198,11 +198,14 @@ class MasterClient:
         mem_gb: float,
         device_mem_gb: float = 0.0,
         device_util: float = 0.0,
+        device_mem_max_gb: float = 0.0,
+        device_util_max: float = 0.0,
     ):
         self.report(
             msg.ResourceStats(
                 self.node_id, cpu_percent, mem_gb,
                 device_mem_gb, device_util,
+                device_mem_max_gb, device_util_max,
             )
         )
 

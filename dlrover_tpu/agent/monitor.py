@@ -54,15 +54,26 @@ def write_device_metrics(path: Optional[str] = None) -> Optional[Dict]:
     import jax
 
     bytes_used = peak = limit = 0
+    max_used = 0
+    max_util = 0.0
     for device in jax.local_devices():
         stats = device.memory_stats() or {}
-        bytes_used += stats.get("bytes_in_use", 0)
+        used = stats.get("bytes_in_use", 0)
+        dev_limit = stats.get("bytes_limit", 0)
+        bytes_used += used
         peak += stats.get("peak_bytes_in_use", 0)
-        limit += stats.get("bytes_limit", 0)
+        limit += dev_limit
+        # Per-device maxima: a single hot device (sharding skew, a
+        # leaked buffer on one chip) hides inside the host-wide sums.
+        max_used = max(max_used, used)
+        if dev_limit:
+            max_util = max(max_util, used / dev_limit)
     payload = {
         "device_mem_gb": bytes_used / 2**30,
         "device_peak_gb": peak / 2**30,
         "device_util": (bytes_used / limit) if limit else 0.0,
+        "device_mem_max_gb": max_used / 2**30,
+        "device_util_max": max_util,
         "timestamp": time.time(),
     }
     tmp = path + ".tmp"
@@ -122,7 +133,8 @@ class ResourceMonitor:
                 cpu_percent = 100.0 * dbusy / dtotal
         self._last_cpu = (busy, total)
         out = {"cpu_percent": cpu_percent, "mem_gb": read_mem_gb(),
-               "device_mem_gb": 0.0, "device_util": 0.0}
+               "device_mem_gb": 0.0, "device_util": 0.0,
+               "device_mem_max_gb": 0.0, "device_util_max": 0.0}
         if self._metrics_file and os.path.exists(self._metrics_file):
             try:
                 faults.fire(
@@ -133,6 +145,12 @@ class ResourceMonitor:
                     device = json.load(f)
                 out["device_mem_gb"] = float(device.get("device_mem_gb", 0.0))
                 out["device_util"] = float(device.get("device_util", 0.0))
+                out["device_mem_max_gb"] = float(
+                    device.get("device_mem_max_gb", 0.0)
+                )
+                out["device_util_max"] = float(
+                    device.get("device_util_max", 0.0)
+                )
             except (OSError, ValueError, faults.FaultInjected):
                 pass
         return out
@@ -189,6 +207,8 @@ class ResourceMonitor:
                 self._client.report_resource(
                     s["cpu_percent"], s["mem_gb"],
                     s["device_mem_gb"], s["device_util"],
+                    device_mem_max_gb=s["device_mem_max_gb"],
+                    device_util_max=s["device_util_max"],
                 )
                 if self._recorder is not None:
                     self._recorder.ship(self._client)

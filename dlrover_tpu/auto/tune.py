@@ -488,6 +488,7 @@ def pick_grad_accum(
     accum_dtype: str = "float32",
     hbm_bytes: Optional[float] = None,
     zero1: bool = False,
+    calibration=None,
 ) -> int:
     """Smallest grad_accum N whose per-microbatch footprint fits HBM.
 
@@ -506,6 +507,12 @@ def pick_grad_accum(
     its 1/dp slice; params and grads stay as before — grads are consumed
     by the reduce-scatter, params re-gather to full size), so a config
     that is opt-state-bound can fit with a smaller N or none at all.
+
+    ``calibration`` (a CalibrationLedger, optional) supplies the measured
+    "memory" ratio — allocator bytes over the shape model, learned from
+    trainers' classified HBM events — so the feasibility walk prices the
+    model's blind spots (temps, fragmentation) instead of leaning on the
+    0.92 margin alone.
     """
     _, _, hbm_default, _ = chip_specs()
     hbm = hbm_bytes if hbm_bytes is not None else hbm_default
@@ -535,10 +542,17 @@ def pick_grad_accum(
         N for N in range(1, per_shard_rows + 1)
         if global_batch_size % (dp * N) == 0
     ] or [1]
+    mem_ratio = 1.0
+    if calibration is not None:
+        try:
+            mem_ratio = float(calibration.ratios().get("memory", 1.0))
+        except Exception:
+            mem_ratio = 1.0
+        mem_ratio = max(mem_ratio, 1e-6)
     for N in feasible:
         extra = accum_b if N > 1 else 0.0
         total = (fixed_b + extra + (act_b + work_b + logits_b) / N) * 1.15
-        if total <= hbm * 0.92:
+        if total * mem_ratio <= hbm * 0.92:
             return N
     return feasible[-1]
 
@@ -833,6 +847,8 @@ def apply_calibration(candidates, ledger):
         return
     r_compute = float(ratios.get("compute", 1.0))
     r_collective = float(ratios.get("collective", 1.0))
+    r_memory = float(ratios.get("memory", 0.0))
+    hbm_gb = chip_specs()[2] / 2**30
     for cand in candidates:
         if cand.rejected or not math.isfinite(cand.est_step_time):
             continue
@@ -840,6 +856,20 @@ def apply_calibration(candidates, ledger):
         base = cand.est_step_time - comm
         cand.est_step_time = base * r_compute + comm * r_collective
         cand.est_comm_time = comm * r_collective
+        if r_memory > 0.0:
+            # Measured allocator-bytes-over-shape-model ratio: the
+            # pruner re-judges the survivor on corrected bytes — a
+            # config the blind 0.92 margin admitted can still be
+            # rejected here once measurement says the model under-
+            # prices real usage.
+            cand.est_hbm_gb *= r_memory
+            if cand.est_hbm_gb > hbm_gb * 0.92:
+                cand.rejected = (
+                    f"calibrated est_hbm {cand.est_hbm_gb:.1f} GiB > "
+                    f"0.92 * {hbm_gb:.0f} GiB "
+                    f"(memory ratio {r_memory:.2f})"
+                )
+                cand.est_step_time = math.inf
 
 
 def auto_tune(

@@ -339,6 +339,24 @@ class DevicePrefetcher:
         # drain() bumps it; the active pass re-places on the mismatch.
         self._generation = 0
         self._buf = None  # the active pass's buffer, for drain() to size
+        # Classified HBM accounting: the device-resident look-ahead
+        # batches are the "prefetch" pool.  Registered as a bound method,
+        # which the registry holds via WeakMethod — a rebuilt prefetcher
+        # (every fit pass makes a fresh one) unregisters itself when the
+        # old instance is collected.
+        from dlrover_tpu.utils import memory_profile
+
+        memory_profile.registry().register(
+            "prefetch", f"prefetch.{id(self)}", self.device_buffers
+        )
+
+    def device_buffers(self):
+        """Device-placed batches currently buffered (empty outside an
+        active pass — ``_buf`` is only bound while iterating)."""
+        buf = self._buf
+        if not buf:
+            return []
+        return [placed for _, placed, _ in buf]
 
     def drain(self) -> int:
         """Invalidate device-buffered placements (keep their host data).

@@ -64,6 +64,19 @@ class DeviceHotRowCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Classified HBM accounting: the hot-row pool registers as a
+        # bound method (WeakMethod inside the registry — a dropped cache
+        # unregisters itself).  The provider reads ``self._cache`` at
+        # call time, so invalidate()'s rebinding stays accounted.
+        from dlrover_tpu.utils import memory_profile
+
+        memory_profile.registry().register(
+            "embed_cache", f"embed_cache.{id(self)}", self.memory_buffers
+        )
+
+    def memory_buffers(self):
+        """Registry provider: the device-resident hot-row pool."""
+        return [self._cache]
 
     # -- residency -------------------------------------------------------------
 

@@ -59,6 +59,9 @@ class DiagnosisContext:
     # Merged job timeline (master/timeline.py) — step-skew evidence for
     # the StragglerOperator.  Optional: None disables skew rules.
     timeline: object = None
+    # Classified HBM ledger (master/memory_ledger.py) — measured
+    # headroom for the HBMPressureOperator.  None disables it.
+    memory: object = None
 
 
 class TrainingHangOperator(InferenceOperator):
@@ -366,6 +369,60 @@ class StepRegressionOperator(InferenceOperator):
         )]
 
 
+class HBMPressureOperator(InferenceOperator):
+    """Measured HBM headroom below the floor: the OOM early-warning.
+
+    Reads the classified MemoryLedger (utils/memory_profile events —
+    *measured* allocator headroom, not tune's modeled bytes) and
+    surfaces ONE latched REPORT naming the tightest node while any node
+    sits under ``HEADROOM_FLOOR``; re-arms once every node recovers
+    past the floor plus hysteresis.  Nodes that cannot price headroom
+    (no allocator limit — the CPU fallback) report ``-1`` and are
+    skipped: unknown is not pressure.  This is the HBM-pressure re-plan
+    signal ROADMAP item 4 names.
+    """
+
+    name = "hbm_pressure"
+    HEADROOM_FLOOR = 0.05   # fire below 5% measured headroom
+    HYSTERESIS = 0.02       # re-arm above floor + 2%
+
+    def __init__(self, floor: Optional[float] = None):
+        if floor is not None:
+            self.HEADROOM_FLOOR = floor
+        self._fired = False
+
+    def observe(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:
+        if ctx.memory is None or not len(ctx.memory):
+            return []
+        pressured = [
+            (snap["headroom_frac"], node_id)
+            for node_id, snap in ctx.memory.per_node().items()
+            if 0.0 <= snap.get("headroom_frac", -1.0)
+            < self.HEADROOM_FLOOR
+        ]
+        if not pressured:
+            fleet = ctx.memory.headroom_frac()
+            if fleet < 0.0 or fleet > self.HEADROOM_FLOOR + self.HYSTERESIS:
+                self._fired = False
+            return []
+        if self._fired:
+            return []
+        self._fired = True
+        headroom, node_id = min(pressured)
+        return [
+            DiagnosisAction(
+                ActionType.REPORT,
+                reason=(
+                    f"measured HBM headroom {headroom:.1%} below "
+                    f"{self.HEADROOM_FLOOR:.0%} floor on "
+                    f"{len(pressured)} node(s)"
+                ),
+                node_id=node_id,
+                severity=2,
+            )
+        ]
+
+
 class InferenceChain:
     """Run the operators, combine evidence, rank the produced actions.
 
@@ -384,6 +441,7 @@ class InferenceChain:
             NumericAnomalyOperator(),
             SDCVoteOperator(),
             StepRegressionOperator(),
+            HBMPressureOperator(),
         ]
 
     def infer(self, ctx: DiagnosisContext) -> List[DiagnosisAction]:

@@ -1,4 +1,4 @@
-"""Live HTTP observability plane: /metrics, /timeline, /healthz.
+"""Live HTTP observability plane: /metrics, /timeline, /healthz, /memory.
 
 Until now the Prometheus text ``JobTimeline.render_metrics`` produces was
 only reachable through the master's pickled-dataclass gRPC surface plus a
@@ -13,8 +13,11 @@ stdlib :class:`http.server.ThreadingHTTPServer` next to the gRPC server
   (``JobTimeline.to_chrome_trace``), loadable straight into
   https://ui.perfetto.dev;
 - ``GET /healthz``  — a small JSON liveness/health document: rendezvous
-  round, live node count, running/quarantined nodes — what a k8s probe or
-  a fleet dashboard needs without parsing the exposition.
+  round, live node count, running/quarantined nodes, measured HBM
+  headroom — what a k8s probe or a fleet dashboard needs without parsing
+  the exposition;
+- ``GET /memory``   — the classified HBM ledger (``MemoryLedger``): the
+  fleet aggregate plus every node's newest per-pool snapshot.
 
 The plane is read-only (GET only) and sits behind the ``http.serve``
 Faultline seam: an injected error answers 503 exactly like a wedged
@@ -35,10 +38,15 @@ from dlrover_tpu.common.log import default_logger as logger
 class MetricsHTTPServer:
     """The master's scrape surface over a servicer."""
 
-    def __init__(self, servicer, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, servicer, host: str = "0.0.0.0", port: int = 0,
+                 healthz_hbm_floor: float = 0.0):
         self.servicer = servicer
         self.host = host
         self.port = port
+        # Healthz flips not-ok when measured HBM headroom drops below
+        # this fraction.  0.0 (the default) disables the check so
+        # existing healthz semantics are unchanged until opted in.
+        self.healthz_hbm_floor = healthz_hbm_floor
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -74,14 +82,37 @@ class MetricsHTTPServer:
                 in self.servicer.node_manager.snapshot().items()
                 if state.get("quarantined")
             )
+        headroom = -1.0
+        ledger = getattr(self.servicer, "memory_ledger", None)
+        if ledger is not None:
+            headroom = ledger.headroom_frac()
+        # Headroom -1 means "no node can price a limit" (the CPU
+        # fallback path) — unknown is not pressure.
+        hbm_ok = not (
+            self.healthz_hbm_floor > 0.0
+            and 0.0 <= headroom < self.healthz_hbm_floor
+        )
         return {
-            "ok": not quarantined,
+            "ok": not quarantined and hbm_ok,
             "rdzv_round": rounds.get("elastic-training", 0),
             "rdzv_rounds": rounds,
             "live_nodes": live,
             "running_nodes": running,
             "quarantined": quarantined,
+            "hbm_headroom_frac": headroom,
+            "hbm_ok": hbm_ok,
         }
+
+    def memory_json(self) -> str:
+        ledger = getattr(self.servicer, "memory_ledger", None)
+        if ledger is None:
+            return json.dumps({"ledger": {}, "nodes": {}})
+        return json.dumps({
+            "ledger": ledger.ledger(),
+            "nodes": {
+                str(k): v for k, v in sorted(ledger.per_node().items())
+            },
+        })
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -102,6 +133,9 @@ class MetricsHTTPServer:
                         ctype = "application/json"
                     elif self.path.startswith("/healthz"):
                         body = json.dumps(plane.healthz()).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/memory"):
+                        body = plane.memory_json().encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)
@@ -134,7 +168,8 @@ class MetricsHTTPServer:
         )
         self._thread.start()
         logger.info(
-            "metrics HTTP plane on %s:%d (/metrics /timeline /healthz)",
+            "metrics HTTP plane on %s:%d "
+            "(/metrics /timeline /healthz /memory)",
             self.host, self.port,
         )
         return self.port

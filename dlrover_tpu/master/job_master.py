@@ -52,12 +52,15 @@ class JobMaster:
         brain_overrides: Optional[Dict[str, float]] = None,
         pools: Optional[Dict[str, int]] = None,
         metrics_port: int = 0,
+        healthz_hbm_floor: float = 0.0,
     ):
         from dlrover_tpu.master.calibration import CalibrationLedger
+        from dlrover_tpu.master.memory_ledger import MemoryLedger
         from dlrover_tpu.master.timeline import JobTimeline
 
         self.speed_monitor = SpeedMonitor()
         self.calibration = CalibrationLedger()
+        self.memory_ledger = MemoryLedger()
         self.task_manager = TaskManager()
         self.kv_store = KVStore()
         self.metrics = MetricsCollector()
@@ -81,6 +84,7 @@ class JobMaster:
             if new_status == _NS.SUCCEEDED:
                 self.metrics.evict(node_id)
                 self.timeline.evict_node(node_id)
+                self.memory_ledger.evict(node_id)
 
         self.node_manager.add_callback(_evict_observability)
         from dlrover_tpu.master.brain import RunningJobOptimizer
@@ -146,11 +150,13 @@ class JobMaster:
             timeline=self.timeline,
             auto_scaler=self.auto_scaler,
             calibration=self.calibration,
+            memory_ledger=self.memory_ledger,
         )
         self._server = None
         self.port = port
         # Live scrape surface (master/http_plane.py); 0 = off.
         self.metrics_port = metrics_port
+        self.healthz_hbm_floor = healthz_hbm_floor
         self.http_plane = None
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
@@ -184,7 +190,8 @@ class JobMaster:
             from dlrover_tpu.master.http_plane import MetricsHTTPServer
 
             self.http_plane = MetricsHTTPServer(
-                self.servicer, port=self.metrics_port
+                self.servicer, port=self.metrics_port,
+                healthz_hbm_floor=self.healthz_hbm_floor,
             )
             self.metrics_port = self.http_plane.start()
 
@@ -343,6 +350,7 @@ class JobMaster:
             node_manager=self.node_manager,
             hang_threshold=self.hang_threshold,
             timeline=self.timeline,
+            memory=self.memory_ledger,
         )
         for action in self.diagnosis.run(ctx):
             logger.error("diagnosis remediation: %s (%s)",
@@ -372,6 +380,9 @@ class JobMaster:
         self.servicer.sync_service.remove_node(node_id)
         self.task_manager.recover_tasks(node_id)
         self.speed_monitor.record_sdc_quarantine(node_id)
+        # A quarantined host's memory snapshot must not keep weighing on
+        # the fleet headroom aggregates (same contract as retirement).
+        self.memory_ledger.evict(node_id)
         self.speed_monitor.begin_resize(reason=f"quarantine:{node_id}")
         self.speed_monitor.reset_running_speed()
         if self.auto_scaler is not None:
@@ -407,6 +418,7 @@ class JobMaster:
         self.task_manager.recover_tasks(node_id)
         self.metrics.evict(node_id)
         self.timeline.evict_node(node_id)
+        self.memory_ledger.evict(node_id)
 
     def stop(self):
         self._stop.set()
@@ -448,12 +460,17 @@ def main():  # python -m dlrover_tpu.master.job_master --port N --nodes N
     parser.add_argument("--heartbeat-timeout", type=float, default=0.0)
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="HTTP scrape port for /metrics /timeline "
-                             "/healthz (0 = off)")
+                             "/healthz /memory (0 = off)")
+    parser.add_argument("--healthz-hbm-floor", type=float, default=0.0,
+                        help="flip /healthz not-ok when measured HBM "
+                             "headroom drops below this fraction "
+                             "(0 = off)")
     args = parser.parse_args()
     master = JobMaster(
         port=args.port, num_nodes=args.nodes, node_unit=args.node_unit,
         min_nodes=args.min_nodes, heartbeat_timeout=args.heartbeat_timeout,
         metrics_port=args.metrics_port,
+        healthz_hbm_floor=args.healthz_hbm_floor,
     )
     master.start()
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)

@@ -240,6 +240,11 @@ class ResourceStats:
     mem_gb: float = 0.0
     device_mem_gb: float = 0.0
     device_util: float = 0.0
+    # Per-device maxima across the host (a single hot device hides
+    # inside the host-wide sums above).  Defaults keep older agents
+    # wire-compatible.
+    device_mem_max_gb: float = 0.0
+    device_util_max: float = 0.0
 
 
 @dataclasses.dataclass

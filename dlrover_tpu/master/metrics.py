@@ -21,8 +21,9 @@ class MetricsCollector:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # node_id -> deque[(ts, cpu%, mem_gb, device_mem_gb, device_util)]
-        self._series: Dict[int, Deque[Tuple[float, float, float, float, float]]] = {}
+        # node_id -> deque[(ts, cpu%, mem_gb, device_mem_gb, device_util,
+        #                   device_mem_max_gb, device_util_max)]
+        self._series: Dict[int, Deque[Tuple[float, ...]]] = {}
 
     def collect(
         self,
@@ -32,13 +33,18 @@ class MetricsCollector:
         device_mem_gb: float = 0.0,
         device_util: float = 0.0,
         timestamp: Optional[float] = None,
+        device_mem_max_gb: float = 0.0,
+        device_util_max: float = 0.0,
     ):
         ts = timestamp or time.time()
         with self._lock:
             series = self._series.setdefault(
                 node_id, deque(maxlen=self.WINDOW)
             )
-            series.append((ts, cpu_percent, mem_gb, device_mem_gb, device_util))
+            series.append((
+                ts, cpu_percent, mem_gb, device_mem_gb, device_util,
+                device_mem_max_gb, device_util_max,
+            ))
 
     def evict(self, node_id: int):
         """Drop a removed node's series (scale-down, migration-out):
@@ -52,13 +58,20 @@ class MetricsCollector:
             series = self._series.get(node_id)
             if not series:
                 return None
-            ts, cpu, mem, dmem, dutil = series[-1]
+            sample = series[-1]
+            # Old snapshots may carry 5-tuples (pre per-device-max);
+            # pad so restores across versions keep working.
+            ts, cpu, mem, dmem, dutil = sample[:5]
+            dmem_max = sample[5] if len(sample) > 5 else 0.0
+            dutil_max = sample[6] if len(sample) > 6 else 0.0
             return {
                 "timestamp": ts,
                 "cpu_percent": cpu,
                 "mem_gb": mem,
                 "device_mem_gb": dmem,
                 "device_util": dutil,
+                "device_mem_max_gb": dmem_max,
+                "device_util_max": dutil_max,
             }
 
     def nodes(self) -> List[int]:

@@ -41,6 +41,7 @@ class MasterServicer:
         auto_scaler=None,
         serve_frontend=None,
         calibration=None,
+        memory_ledger=None,
     ):
         self.rdzv_managers = rdzv_managers or {}
         self.task_manager = task_manager
@@ -58,6 +59,9 @@ class MasterServicer:
         # Calibration ledger (master/calibration.py): "calibration" wire
         # events from profiled trainers fold in here.
         self.calibration = calibration
+        # Classified HBM ledger (master/memory_ledger.py): "memory" wire
+        # events from trainers/engines fold in here.
+        self.memory_ledger = memory_ledger
         from dlrover_tpu.master.sync_service import SyncService
 
         self.sync_service = SyncService()
@@ -376,6 +380,33 @@ class MasterServicer:
                         "unparseable embed event from %d: %r",
                         node, attrs,
                     )
+            elif name == "memory":
+                # Classified HBM snapshot (utils/memory_profile emits
+                # them on the report cadence): newest-wins per node in
+                # the MemoryLedger behind dlrover_hbm_* / /memory /
+                # HBMPressureOperator, plus one measured-vs-modeled
+                # bytes pairing for the calibration ledger so tune's
+                # pruner runs on corrected bytes.
+                if self.memory_ledger is not None:
+                    try:
+                        self.memory_ledger.record(node, **attrs)
+                    except (TypeError, ValueError):
+                        logger.warning(
+                            "unparseable memory event from %d: %r",
+                            node, attrs,
+                        )
+                if self.calibration is not None:
+                    try:
+                        self.calibration.observe(
+                            str(attrs.get("cache_key", "")), "memory",
+                            float(attrs.get("measured_b", 0.0)),
+                            float(attrs.get("modeled_b", 0.0)),
+                        )
+                    except (TypeError, ValueError):
+                        logger.warning(
+                            "unparseable memory calibration from %d: %r",
+                            node, attrs,
+                        )
             elif self.calibration is not None and name == "calibration":
                 # One measured/modeled pairing per capture window (flat
                 # float attrs; utils/device_profile emits them) folds
@@ -424,6 +455,8 @@ class MasterServicer:
             speed_monitor=self.speed_monitor,
             node_manager=self.node_manager,
             calibration=self.calibration,
+            memory=self.memory_ledger,
+            metrics=self.metrics,
         )
 
     def _get_timeline(self, env: msg.Envelope):
@@ -438,6 +471,8 @@ class MasterServicer:
             self.metrics.collect(
                 p.node_id, p.cpu_percent, p.mem_gb,
                 p.device_mem_gb, p.device_util,
+                device_mem_max_gb=p.device_mem_max_gb,
+                device_util_max=p.device_util_max,
             )
 
     def _get_job_status(self, env: msg.Envelope):

@@ -79,6 +79,10 @@ class MasterStateStore:
             # at a slow cadence — relearning them after a master restart
             # would leave the tuner uncorrected for hours.
             "calibration": master.calibration.state(),
+            # Classified HBM snapshots: a restarted master must keep the
+            # fleet's memory truth (healthz floor, HBM gauges, pressure
+            # operator) instead of flying blind until the next report.
+            "memory": master.memory_ledger.state(),
         }
 
     def save(self, master):
@@ -154,6 +158,8 @@ class MasterStateStore:
             master.speed_monitor.restore_embed_state(state["embed"])
         if state.get("calibration"):
             master.calibration.restore(state["calibration"])
+        if state.get("memory"):
+            master.memory_ledger.restore(state["memory"])
         if state.get("global_step"):
             master.speed_monitor.collect_global_step(
                 state["global_step"], timestamp=time.time()

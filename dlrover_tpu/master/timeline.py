@@ -226,6 +226,8 @@ class JobTimeline:
         speed_monitor=None,
         node_manager=None,
         calibration=None,
+        memory=None,
+        metrics=None,
     ) -> str:
         """Prometheus text exposition of the merged job state.
 
@@ -260,8 +262,10 @@ class JobTimeline:
             gauge("dlrover_restart_compile_seconds_total",
                   ledger["restart_compile_s"],
                   "compile seconds paid on restarts (cache misses)")
-            gauge("dlrover_compile_events_total", ledger["compile_events"])
-            gauge("dlrover_cached_compiles_total", ledger["cached_compiles"])
+            gauge("dlrover_compile_events_total", ledger["compile_events"],
+                  "compile events trainers reported (cache hits included)")
+            gauge("dlrover_cached_compiles_total", ledger["cached_compiles"],
+                  "compile events served from the persistent cache")
             fault_ledger = speed_monitor.fault_ledger()
             gauge("dlrover_injected_faults_total",
                   fault_ledger["fault_events"],
@@ -437,5 +441,59 @@ class JobTimeline:
             for node_id, state in sorted(node_manager.snapshot().items()):
                 gauge("dlrover_node_relaunch_count",
                       state["relaunch_count"],
+                      labels=f'{{node="{node_id}"}}')
+        if memory is not None and len(memory):
+            hbm = memory.ledger()
+            gauge("dlrover_hbm_nodes", hbm["nodes"],
+                  "nodes with a live classified HBM snapshot")
+            gauge("dlrover_hbm_bytes_in_use", hbm["bytes_in_use"],
+                  "allocator bytes_in_use summed over reporting nodes "
+                  "(live-buffer nbytes fallback where the backend has "
+                  "no allocator stats)")
+            gauge("dlrover_hbm_peak_bytes", hbm["peak_bytes"],
+                  "worst single-node peak allocator bytes")
+            gauge("dlrover_hbm_limit_bytes", hbm["limit_bytes"],
+                  "allocator bytes_limit summed over reporting nodes "
+                  "(0 = backend does not price a limit)")
+            gauge("dlrover_hbm_headroom_frac", hbm["headroom_frac"],
+                  "tightest node's 1 - bytes_in_use/limit "
+                  "(-1 = no node can price headroom)")
+            lines.append(
+                "# HELP dlrover_hbm_pool_bytes per-device bytes by "
+                "classified pool, summed over reporting nodes"
+            )
+            lines.append("# TYPE dlrover_hbm_pool_bytes gauge")
+            from dlrover_tpu.utils.memory_profile import POOLS
+            for pool in POOLS:
+                gauge("dlrover_hbm_pool_bytes", hbm[f"pool_{pool}_b"],
+                      labels=f'{{pool="{pool}"}}')
+        if metrics is not None and metrics.nodes():
+            lines.append(
+                "# HELP dlrover_host_device_mem_gb host-wide device "
+                "memory in use, summed over the node's local devices"
+            )
+            lines.append("# TYPE dlrover_host_device_mem_gb gauge")
+            lines.append(
+                "# HELP dlrover_host_device_mem_max_gb hottest single "
+                "device's memory on the node (skew the sum hides)"
+            )
+            lines.append("# TYPE dlrover_host_device_mem_max_gb gauge")
+            lines.append(
+                "# HELP dlrover_host_device_util_max hottest single "
+                "device's utilization on the node (0..1)"
+            )
+            lines.append("# TYPE dlrover_host_device_util_max gauge")
+            for node_id in metrics.nodes():
+                sample = metrics.latest(node_id)
+                if not sample:
+                    continue
+                gauge("dlrover_host_device_mem_gb",
+                      sample["device_mem_gb"],
+                      labels=f'{{node="{node_id}"}}')
+                gauge("dlrover_host_device_mem_max_gb",
+                      sample["device_mem_max_gb"],
+                      labels=f'{{node="{node_id}"}}')
+                gauge("dlrover_host_device_util_max",
+                      sample["device_util_max"],
                       labels=f'{{node="{node_id}"}}')
         return "\n".join(lines) + "\n"

@@ -261,6 +261,32 @@ class ServingEngine:
         # tell which weights each replica is answering with.
         self.weights_version = 0
         self._digest_fn = None
+        # Classified HBM accounting: serving params + the paged KV pool
+        # (target and draft) register as bound methods, which the
+        # registry holds via WeakMethod — a torn-down engine (fleet
+        # replica kill, bench teardown) unregisters itself on collection.
+        from dlrover_tpu.utils import memory_profile
+
+        memory_profile.registry().register(
+            "params", f"serve.{id(self)}.params", self.memory_params
+        )
+        memory_profile.registry().register(
+            "kv_pool", f"serve.{id(self)}.kv", self.memory_kv_pool
+        )
+
+    def memory_params(self):
+        """Registry provider: device params (target + draft)."""
+        out = [self.params]
+        if self.draft_params is not None:
+            out.append(self.draft_params)
+        return out
+
+    def memory_kv_pool(self):
+        """Registry provider: the paged KV pool (target + draft)."""
+        out = [self.cache]
+        if self.draft_cache is not None:
+            out.append(self.draft_cache)
+        return out
 
     # -- admission ------------------------------------------------------------
 

@@ -125,6 +125,15 @@ class TrainerConfig:
     # parse; 0 disables — no profiler object is built and the step path
     # allocates nothing.
     profile_every: int = 0
+    # -- classified HBM accounting --------------------------------------------
+    # Register the trainer's buffers (params / optimizer state / prefetch)
+    # in the utils/memory_profile registry and ship one flat-attr
+    # ``memory`` telemetry event per report tick: allocator bytes (or the
+    # live-buffer nbytes fallback), per-pool classified bytes, the
+    # compiled program's memory_analysis, and the measured-vs-modeled
+    # bytes pairing for the master's calibration ledger.  Off (default):
+    # the step path pays one attribute read and nothing else.
+    memory_report: bool = False
     # World size ``grad_accum`` was chosen for; 0 = the world at first
     # construction.  Booked in checkpoint `extra` so a restore into a
     # different world recomputes N from the ORIGINAL reference pairing.
@@ -303,6 +312,25 @@ class ElasticTrainer:
             if self.client is not None:
                 self.client.report_event("compile", json.dumps(detail))
         self.state = self.train.init(jax.random.PRNGKey(0))
+        # Classified HBM accounting: None when off, so _report pays one
+        # attribute read and nothing else (the same off-path contract as
+        # the device profiler above).
+        self._memory_registry = None
+        if config.memory_report:
+            from dlrover_tpu.utils import memory_profile
+
+            self._memory_registry = memory_profile.registry()
+            self._memory_registry.register(
+                "params", "trainer.params", lambda: self.state.params
+            )
+            self._memory_registry.register(
+                "opt_state", "trainer.opt_state",
+                lambda: self.state.opt_state,
+            )
+            memory_profile.record_compiled_analysis(
+                self._current_cache_key() or "",
+                self.train.memory_analysis or {},
+            )
         self.step = 0
         self._last_saved = 0
         self._ckpt = None
@@ -734,7 +762,21 @@ class ElasticTrainer:
     def _dispatch_step(self, batch: Dict[str, Any]):
         placed = train_lib.shard_batch(batch, self.train)
         t0 = time.perf_counter()
-        self.state, metrics = self.train.step(self.state, placed)
+        try:
+            self.state, metrics = self.train.step(self.state, placed)
+        except Exception as e:
+            # OOM forensics: before the process dies, write the
+            # classified live-buffer table (who held the HBM) next to
+            # the checkpoint dir.  Best-effort, then re-raise — the
+            # postmortem must never mask the original error.
+            from dlrover_tpu.utils import memory_profile
+
+            if memory_profile.is_oom_error(e) and self.config.checkpoint_dir:
+                memory_profile.dump_oom_postmortem(
+                    self.config.checkpoint_dir, error=e,
+                    cache_key=self._current_cache_key(),
+                )
+            raise
         self.step += 1
         pipeline_counters().record_dispatch(
             self.step, time.perf_counter() - t0
@@ -1114,6 +1156,12 @@ class ElasticTrainer:
                 anomalies = tuple(a.encode() for a in found)
                 if any(a.kind == "nan" for a in found):
                     self._state_poisoned = True
+        if self._memory_registry is not None:
+            # Classified HBM snapshot on the report cadence, queued
+            # BEFORE the ring ships below so it rides this report's
+            # drain RPC.  Off path (memory_report=False) this branch is
+            # the one attribute read.
+            self._emit_memory_event(step)
         if self.client is not None:
             self.client.report_step(
                 step,
@@ -1143,6 +1191,21 @@ class ElasticTrainer:
         from dlrover_tpu.agent.monitor import write_device_metrics
 
         write_device_metrics()
+
+    def _emit_memory_event(self, step: int):
+        """One flat-attr ``memory`` event: allocator truth + classified
+        pool bytes.  ``modeled_b`` is the shardings-derived param+opt
+        model — the same quantity tune's est_hbm_gb books — so the
+        master's calibration ratio measures what the shape model misses
+        (temps, fragmentation, XLA slack)."""
+        from dlrover_tpu.utils import memory_profile
+
+        pools = self._memory_registry.pool_bytes()
+        memory_profile.emit_memory_event(
+            step=step,
+            cache_key=self._current_cache_key(),
+            modeled_b=pools["params"] + pools["opt_state"],
+        )
 
     # -- checkpoint -----------------------------------------------------------
 

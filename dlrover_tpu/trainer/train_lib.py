@@ -274,6 +274,10 @@ class ShardedTrain:
     apply_fn: Optional[Callable] = None
     tx: Optional[optax.GradientTransformation] = None
     _aot_step: Optional[Callable] = None
+    # Compiled program's memory_analysis() (flat xla_*_b bytes dict from
+    # utils/memory_profile), captured by aot_compile where the backend
+    # provides it — the compiler-side half of the HBM accounting plane.
+    memory_analysis: Optional[Dict[str, int]] = None
 
     def init(self, rng: jax.Array) -> TrainState:
         with use_mesh(self.mesh):
@@ -315,6 +319,11 @@ class ShardedTrain:
             self._aot_step = self.step_fn.lower(
                 abstract_state, self.batch_avals
             ).compile()
+        from dlrover_tpu.utils import memory_profile
+
+        self.memory_analysis = memory_profile.compiled_memory_analysis(
+            self._aot_step
+        )
         return time.perf_counter() - t0
 
 

@@ -1,0 +1,376 @@
+"""Classified HBM accounting: measure device memory, name every byte.
+
+Every memory number the framework acted on before this module was a
+*model*: ``auto/tune.py`` prunes candidates against an analytic
+``est_hbm_gb`` and a blind ``0.92 * hbm_bytes`` margin, and the ZeRO-1 /
+TP-serving "per-device bytes fall as 1/dp, 1/tp" claims were asserted
+from shardings, never measured.  This module is the measurement half:
+
+- :func:`device_memory_stats` snapshots the allocator's per-device
+  ``memory_stats()`` (bytes_in_use / peak / limit).  The CPU backend
+  returns ``None`` there, so the snapshot falls back to summing
+  per-device shard ``nbytes`` over ``jax.live_arrays()`` — shape truth
+  derived from the *live* buffers, not from a plan.
+- :class:`BufferRegistry` classifies live buffers into named pools
+  (params, optimizer state, KV pool, embedding hot-row cache, prefetch
+  buffers, other).  Owners register a zero-arg provider returning the
+  arrays they hold; bound-method providers are held via
+  ``weakref.WeakMethod`` so a dead owner silently unregisters itself.
+- :func:`record_compiled_analysis` books the compiled program's
+  ``memory_analysis()`` (temp / argument / output / generated-code
+  bytes) keyed by the compile-cache key — the XLA-side complement to
+  the allocator numbers.
+- :func:`emit_memory_event` ships one flat-attr ``memory`` telemetry
+  event per report tick for the master's ``MemoryLedger``.
+- :func:`dump_oom_postmortem` writes a classified live-buffer table
+  (pool, shape, dtype, sharding, per-device nbytes, top-N) next to the
+  checkpoint dir when a step dies with ``RESOURCE_EXHAUSTED``.
+
+All byte accounting is **per device**: a registered array contributes
+``prod(shard_shape) * itemsize``, so ZeRO-1 opt-state sharding and TP
+KV sharding show up as measured 1/dp and 1/tp — certified by
+``tools/memory_bench.py``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import math
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from dlrover_tpu.common import telemetry
+from dlrover_tpu.common.log import default_logger as logger
+
+#: Pool names, in the order postmortem tables and gauges present them.
+POOLS: Tuple[str, ...] = (
+    "params", "opt_state", "kv_pool", "embed_cache", "prefetch", "other",
+)
+
+
+def per_device_nbytes(arr: Any) -> int:
+    """Bytes one device holds for ``arr``: ``prod(shard_shape) *
+    itemsize`` when a sharding is attached, plain ``nbytes`` otherwise
+    (host numpy riding in a prefetch buffer, scalars)."""
+    try:
+        shard = arr.sharding.shard_shape(arr.shape)
+        return int(math.prod(shard)) * int(arr.dtype.itemsize)
+    except Exception:
+        try:
+            return int(arr.nbytes)
+        except Exception:
+            return 0
+
+
+def _leaves(tree: Any) -> List[Any]:
+    return [
+        leaf for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype") and hasattr(leaf, "shape")
+    ]
+
+
+def tree_device_nbytes(tree: Any) -> int:
+    """Per-device bytes of every array leaf in ``tree``."""
+    return sum(per_device_nbytes(leaf) for leaf in _leaves(tree))
+
+
+class BufferRegistry:
+    """Name → (pool, provider) map classifying live device buffers.
+
+    A provider is a zero-arg callable returning the arrays (any pytree)
+    its owner currently holds on device; it is called only at snapshot
+    time, so registration costs one dict insert and the step path costs
+    nothing.  Bound methods are stored as ``weakref.WeakMethod`` — when
+    the owner is collected the entry prunes itself at the next
+    snapshot, so per-instance registrations (prefetchers rebuilt every
+    epoch, short-lived serving engines) cannot leak.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Tuple[str, Any]] = {}
+
+    def register(self, pool: str, name: str, provider: Callable[[], Any]):
+        if pool not in POOLS:
+            pool = "other"
+        if inspect.ismethod(provider):
+            provider = weakref.WeakMethod(provider)
+        with self._lock:
+            self._entries[name] = (pool, provider)
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _resolved(self) -> List[Tuple[str, str, Callable[[], Any]]]:
+        """(pool, name, live provider) triples; prunes dead WeakMethods."""
+        out = []
+        dead = []
+        with self._lock:
+            items = list(self._entries.items())
+        for name, (pool, provider) in items:
+            fn = provider() if isinstance(provider, weakref.WeakMethod) \
+                else provider
+            if fn is None:
+                dead.append(name)
+                continue
+            out.append((pool, name, fn))
+        if dead:
+            with self._lock:
+                for name in dead:
+                    self._entries.pop(name, None)
+        return out
+
+    def pool_bytes(self) -> Dict[str, int]:
+        """Per-device bytes per pool.  A provider that raises is counted
+        as zero — accounting must never take down the step loop."""
+        totals = {pool: 0 for pool in POOLS}
+        for pool, name, fn in self._resolved():
+            try:
+                totals[pool] += tree_device_nbytes(fn())
+            except Exception as e:  # pragma: no cover - defensive
+                logger.debug("memory registry provider %s failed: %s",
+                             name, e)
+        return totals
+
+    def rows(self) -> List[Dict[str, Any]]:
+        """One classified row per registered array leaf, largest first —
+        the postmortem table."""
+        rows: List[Dict[str, Any]] = []
+        for pool, name, fn in self._resolved():
+            try:
+                leaves = _leaves(fn())
+            except Exception:
+                continue
+            for leaf in leaves:
+                try:
+                    sharding = str(getattr(leaf, "sharding", ""))
+                except Exception:
+                    sharding = ""
+                rows.append({
+                    "pool": pool,
+                    "name": name,
+                    "shape": list(getattr(leaf, "shape", ())),
+                    "dtype": str(getattr(leaf, "dtype", "?")),
+                    "sharding": sharding,
+                    "nbytes": per_device_nbytes(leaf),
+                })
+        rows.sort(key=lambda r: -r["nbytes"])
+        return rows
+
+
+_REGISTRY = BufferRegistry()
+
+
+def registry() -> BufferRegistry:
+    """The process-wide buffer registry."""
+    return _REGISTRY
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    """Allocator truth per local device, summed across the host.
+
+    Returns flat ``bytes_in_use`` / ``peak_bytes`` / ``limit_bytes``
+    plus ``source``.  Where the backend has no allocator stats (the CPU
+    backend returns ``None``), ``bytes_in_use`` falls back to summing
+    per-device shard ``nbytes`` over ``jax.live_arrays()`` — measured
+    from the live buffers, with ``limit_bytes`` left at 0 (unknown).
+    """
+    in_use = peak = limit = 0
+    have_stats = False
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        have_stats = True
+        in_use += int(stats.get("bytes_in_use", 0))
+        peak += int(stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0)))
+        limit += int(stats.get("bytes_limit", 0))
+    if not have_stats:
+        try:
+            in_use = sum(
+                per_device_nbytes(arr) for arr in jax.live_arrays()
+            ) * max(1, len(jax.local_devices()))
+        except Exception:
+            in_use = 0
+        # live_arrays() has no high-water mark; report current as peak.
+        peak = in_use
+    headroom = (1.0 - in_use / limit) if limit > 0 else -1.0
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes": peak,
+        "limit_bytes": limit,
+        "headroom_frac": headroom,
+        "source": "allocator" if have_stats else "nbytes_fallback",
+    }
+
+
+# Compiled-program memory_analysis() per compile-cache key: the XLA
+# compiler's own accounting of the live step program.
+_ANALYSES: Dict[str, Dict[str, int]] = {}
+_ANALYSES_LOCK = threading.Lock()
+
+_ANALYSIS_FIELDS = {
+    "xla_temp_b": "temp_size_in_bytes",
+    "xla_arg_b": "argument_size_in_bytes",
+    "xla_out_b": "output_size_in_bytes",
+    "xla_code_b": "generated_code_size_in_bytes",
+}
+
+
+def compiled_memory_analysis(compiled: Any) -> Optional[Dict[str, int]]:
+    """Extract ``memory_analysis()`` from a compiled program into the
+    flat ``xla_*_b`` dict, or ``None`` where the backend lacks it."""
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:
+        return None
+    if analysis is None:
+        return None
+    out = {}
+    for attr, field in _ANALYSIS_FIELDS.items():
+        out[attr] = int(getattr(analysis, field, 0) or 0)
+    return out
+
+
+def record_compiled_analysis(cache_key: str, compiled: Any
+                             ) -> Optional[Dict[str, int]]:
+    """Book a compiled program's memory analysis under its compile-cache
+    key (the same key the calibration ledger uses)."""
+    info = compiled if isinstance(compiled, dict) \
+        else compiled_memory_analysis(compiled)
+    if info is None:
+        return None
+    with _ANALYSES_LOCK:
+        _ANALYSES[cache_key or "uncacheable"] = dict(info)
+    return info
+
+
+def compiled_analysis(cache_key: str) -> Optional[Dict[str, int]]:
+    with _ANALYSES_LOCK:
+        info = _ANALYSES.get(cache_key or "uncacheable")
+        return dict(info) if info else None
+
+
+def snapshot(cache_key: str = "") -> Dict[str, Any]:
+    """One classified memory snapshot: allocator stats + per-pool bytes
+    + the booked compile analysis for ``cache_key``.  Flat dict — the
+    payload of the ``memory`` telemetry event."""
+    snap: Dict[str, Any] = device_memory_stats()
+    pools = _REGISTRY.pool_bytes()
+    for pool in POOLS:
+        snap[f"pool_{pool}_b"] = pools[pool]
+    analysis = compiled_analysis(cache_key)
+    if analysis:
+        snap.update(analysis)
+    return snap
+
+
+def emit_memory_event(
+    *,
+    step: int,
+    cache_key: str = "",
+    modeled_b: float = 0.0,
+) -> Optional[Dict[str, Any]]:
+    """Ship one flat-attr ``memory`` event on the report cadence.
+
+    ``measured_b`` is the allocator's bytes_in_use (or the live-array
+    fallback); ``modeled_b`` is the caller's analytic estimate for the
+    same buffers — the pair feeds the master's calibration ledger so
+    tune's pruner runs on corrected bytes.  Returns the attrs emitted,
+    or ``None`` when the recorder is disabled.
+    """
+    if not telemetry.recorder().enabled:
+        return None
+    attrs = snapshot(cache_key)
+    attrs["step"] = int(step)
+    attrs["cache_key"] = cache_key or "uncacheable"
+    attrs["measured_b"] = float(attrs["bytes_in_use"])
+    attrs["modeled_b"] = float(modeled_b)
+    attrs["headroom_frac"] = round(float(attrs["headroom_frac"]), 4)
+    telemetry.event("memory", **attrs)
+    return attrs
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Does this look like a device allocator exhaustion?"""
+    text = f"{type(e).__name__}: {e}"
+    return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text \
+        or "out of memory" in text
+
+
+def dump_oom_postmortem(
+    directory: str,
+    *,
+    error: Optional[BaseException] = None,
+    cache_key: str = "",
+    top_n: int = 20,
+) -> Optional[str]:
+    """Write the classified live-buffer table next to the checkpoint
+    dir after an OOM: who was holding the HBM when the allocator gave
+    up.  Registered buffers carry their pool; the long tail of live
+    arrays the registry has never seen is summed into ``other``.
+    Returns the path written, or ``None`` on any failure (forensics
+    must never mask the original error)."""
+    try:
+        rows = _REGISTRY.rows()
+        registered = {id(leaf) for _, _, fn in _REGISTRY._resolved()
+                      for leaf in _leaves(_safe_call(fn))}
+        unregistered_b = 0
+        unregistered_n = 0
+        try:
+            for arr in jax.live_arrays():
+                if id(arr) not in registered:
+                    unregistered_b += per_device_nbytes(arr)
+                    unregistered_n += 1
+        except Exception:
+            pass
+        report = {
+            "error": f"{type(error).__name__}: {error}" if error else "",
+            "cache_key": cache_key or "uncacheable",
+            "device": device_memory_stats(),
+            "compiled": compiled_analysis(cache_key),
+            "pools_b": _REGISTRY.pool_bytes(),
+            "unclassified_live_b": unregistered_b,
+            "unclassified_live_n": unregistered_n,
+            "top": rows[:top_n],
+            "rows_total": len(rows),
+        }
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "oom_postmortem.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, path)
+        logger.error(
+            "OOM postmortem: %d classified buffers, pools=%s -> %s",
+            len(rows), report["pools_b"], path,
+        )
+        return path
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("OOM postmortem dump failed: %s", e)
+        return None
+
+
+def _safe_call(fn: Callable[[], Any]) -> Any:
+    try:
+        return fn()
+    except Exception:
+        return ()

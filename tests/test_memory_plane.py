@@ -1,0 +1,412 @@
+"""Classified HBM accounting plane: registry, ledger, gauges, forensics.
+
+Covers the memory-truth chain end to end on the virtual CPU mesh:
+``utils/memory_profile`` pricing + classification, the ``memory``
+telemetry event and its servicer routing into ``MemoryLedger`` +
+calibration, the ``dlrover_hbm_*`` gauges and the exposition lint
+(every rendered ``dlrover_*`` metric carries ``# HELP``/``# TYPE``),
+the ``/memory`` + ``/healthz`` HTTP surface, the ``HBMPressureOperator``
+latch, ledger lifecycle through retirement/quarantine/state-snapshot,
+and the OOM postmortem table.
+"""
+
+import gc
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import telemetry
+from dlrover_tpu.master import messages as msg
+from dlrover_tpu.master.calibration import CalibrationLedger
+from dlrover_tpu.master.diagnosis import (
+    ActionType,
+    DiagnosisContext,
+    HBMPressureOperator,
+)
+from dlrover_tpu.master.memory_ledger import MemoryLedger
+from dlrover_tpu.master.metrics import MetricsCollector
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.timeline import JobTimeline
+from dlrover_tpu.utils import memory_profile as mp
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    mp.registry().clear()
+    yield
+    mp.registry().clear()
+
+
+# -- pricing + classification (the registry) --------------------------------
+
+
+def test_per_device_nbytes_prices_the_shard():
+    """A data-sharded array must price 1/dp of the global bytes — the
+    property the whole measured-vs-modeled plane rests on."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    arr = jax.device_put(
+        jnp.zeros((16, 8), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("data", None)),
+    )
+    assert mp.per_device_nbytes(arr) == arr.nbytes // 4
+    replicated = jax.device_put(
+        jnp.zeros((16, 8), jnp.float32),
+        NamedSharding(mesh, PartitionSpec(None, None)),
+    )
+    assert mp.per_device_nbytes(replicated) == replicated.nbytes
+
+
+def test_registry_classifies_and_prices_pools():
+    x = jnp.ones((64, 32), jnp.float32)
+    reg = mp.BufferRegistry()
+    reg.register("params", "t.params", lambda: {"w": x})
+    reg.register("mystery", "t.mystery", lambda: [x])  # unknown -> other
+    pools = reg.pool_bytes()
+    assert pools["params"] == x.nbytes
+    assert pools["other"] == x.nbytes
+    rows = reg.rows()
+    assert rows[0]["pool"] in ("params", "other")
+    assert all(r["nbytes"] == x.nbytes for r in rows)
+    assert {r["dtype"] for r in rows} == {"float32"}
+
+
+def test_registry_weakmethod_provider_dies_with_owner():
+    """A bound-method provider must not keep its owner alive: a dropped
+    prefetcher/engine/cache self-unregisters at the next snapshot."""
+
+    class Owner:
+        def __init__(self):
+            self.buf = jnp.ones((8, 8), jnp.float32)
+
+        def buffers(self):
+            return [self.buf]
+
+    reg = mp.BufferRegistry()
+    owner = Owner()
+    reg.register("prefetch", "owner.buf", owner.buffers)
+    assert reg.pool_bytes()["prefetch"] == owner.buf.nbytes
+    del owner
+    gc.collect()
+    assert reg.pool_bytes()["prefetch"] == 0
+    assert len(reg) == 0  # the dead entry was pruned, not just skipped
+
+
+def test_registry_provider_exception_prices_zero():
+    reg = mp.BufferRegistry()
+    reg.register("kv_pool", "broken", lambda: 1 / 0)
+    assert reg.pool_bytes()["kv_pool"] == 0
+
+
+# -- the memory event --------------------------------------------------------
+
+
+def test_emit_memory_event_disabled_costs_one_attr_read():
+    recorder = telemetry.recorder()
+    was_enabled = recorder.enabled
+    recorder.configure(enabled=False)
+    try:
+        before = len(recorder.peek())
+        assert mp.emit_memory_event(step=1) is None
+        after = [ev for ev in recorder.peek() if ev[0] == "memory"]
+        assert len(recorder.peek()) == before and after == []
+    finally:
+        recorder.configure(enabled=was_enabled)
+
+
+def test_emit_memory_event_flat_attrs_and_analysis():
+    x = jnp.ones((32, 16), jnp.float32)
+    mp.registry().register("params", "t.params", lambda: [x])
+
+    @jax.jit
+    def f(a):
+        return (a @ a.T).sum()
+
+    compiled = f.lower(x).compile()
+    mp.record_compiled_analysis("ck1", compiled)
+
+    recorder = telemetry.recorder()
+    was_enabled = recorder.enabled
+    recorder.configure(enabled=True)
+    try:
+        recorder.drain()
+        attrs = mp.emit_memory_event(
+            step=7, cache_key="ck1", modeled_b=float(x.nbytes)
+        )
+        events = [ev for ev in recorder.drain() if ev[0] == "memory"]
+    finally:
+        recorder.configure(enabled=was_enabled)
+    assert len(events) == 1
+    name, kind, _, _, wired = events[0]
+    assert wired["step"] == 7
+    assert wired["cache_key"] == "ck1"
+    assert wired["pool_params_b"] == x.nbytes
+    assert wired["measured_b"] > 0
+    assert wired["modeled_b"] == x.nbytes
+    assert wired["xla_temp_b"] >= 0  # AOT analysis attached by cache key
+    # Flat attrs only: everything the wire carries must be scalar.
+    assert all(
+        isinstance(v, (int, float, str)) for v in attrs.values()
+    )
+
+
+def test_servicer_routes_memory_events_to_ledger_and_calibration():
+    timeline = JobTimeline()
+    ledger = MemoryLedger()
+    calibration = CalibrationLedger()
+    servicer = MasterServicer(
+        timeline=timeline, memory_ledger=ledger, calibration=calibration
+    )
+    event = ("memory", "event", 1000.0, 0.0, {
+        "step": 3, "cache_key": "ck", "bytes_in_use": 800.0,
+        "peak_bytes": 900.0, "limit_bytes": 1000.0,
+        "headroom_frac": 0.2, "measured_b": 800.0, "modeled_b": 640.0,
+        "pool_params_b": 500.0, "pool_opt_state_b": 300.0,
+        "source": "allocator",
+    })
+    servicer._report_telemetry(msg.Envelope(
+        node_id=2, node_type="worker", job_name="t",
+        payload=msg.TelemetryEvents(node_id=2, events=(event,), dropped=0),
+    ))
+    assert len(ledger) == 1
+    booked = ledger.per_node()[2]
+    assert booked["bytes_in_use"] == 800.0
+    assert booked["cache_key"] == "ck"
+    assert ledger.headroom_frac() == pytest.approx(0.2)
+    assert calibration.ratios()["memory"] == pytest.approx(800.0 / 640.0)
+    # Malformed attrs must not take the servicer down.
+    bad = ("memory", "event", 1000.0, 0.0, {"bytes_in_use": "junk"})
+    servicer._report_telemetry(msg.Envelope(
+        node_id=2, node_type="worker", job_name="t",
+        payload=msg.TelemetryEvents(node_id=2, events=(bad,), dropped=0),
+    ))
+    assert len(ledger) == 1
+
+
+# -- ledger lifecycle --------------------------------------------------------
+
+
+def _snapshot_attrs(headroom=0.5, in_use=500.0):
+    return {
+        "bytes_in_use": in_use, "peak_bytes": in_use,
+        "limit_bytes": 1000.0, "headroom_frac": headroom,
+        "pool_params_b": in_use,
+    }
+
+
+def test_memory_ledger_newest_wins_evict_and_aggregate():
+    ledger = MemoryLedger()
+    ledger.record(0, **_snapshot_attrs(headroom=0.5))
+    ledger.record(0, **_snapshot_attrs(headroom=0.4, in_use=600.0))
+    ledger.record(1, **_snapshot_attrs(headroom=0.1))
+    agg = ledger.ledger()
+    assert agg["nodes"] == 2
+    assert agg["events"] == 3
+    assert agg["bytes_in_use"] == 1100.0
+    assert ledger.headroom_frac() == pytest.approx(0.1)  # tightest node
+    ledger.evict(1)
+    assert ledger.headroom_frac() == pytest.approx(0.4)
+    ledger.evict(99)  # unknown node: no-op
+    assert len(ledger) == 1
+
+
+def test_memory_ledger_unknown_headroom_is_not_pressure():
+    ledger = MemoryLedger()
+    ledger.record(0, bytes_in_use=100.0, headroom_frac=-1.0)
+    assert ledger.headroom_frac() == -1.0
+    ledger.record(1, **_snapshot_attrs(headroom=0.3))
+    assert ledger.headroom_frac() == pytest.approx(0.3)
+
+
+def test_memory_ledger_survives_master_state_snapshot(tmp_path):
+    """Retirement/quarantine evict, and the ledger rides the master state
+    snapshot through a restart round-trip."""
+    from dlrover_tpu.master.job_master import JobMaster
+
+    path = str(tmp_path / "master_state.json")
+    master = JobMaster(num_nodes=2, min_nodes=1, state_path=path)
+    try:
+        master.memory_ledger.record(0, **_snapshot_attrs())
+        master.memory_ledger.record(1, **_snapshot_attrs(headroom=0.2))
+        master._state_store.save(master)
+    finally:
+        master.stop()
+
+    reborn = JobMaster(num_nodes=2, min_nodes=1, state_path=path)
+    try:
+        reborn.start()
+        assert len(reborn.memory_ledger) == 2
+        assert reborn.memory_ledger.headroom_frac() == pytest.approx(0.2)
+
+        # Quarantine evicts the node's stale snapshot with it.
+        reborn.node_manager.ensure_node(1)
+        reborn._quarantine_node(1, "digest minority x2")
+        assert len(reborn.memory_ledger) == 1
+        # Retirement evicts too.
+        reborn.memory_ledger.record(5, **_snapshot_attrs())
+        reborn._handle_node_retired(5)
+        assert 5 not in reborn.memory_ledger.per_node()
+    finally:
+        reborn.stop()
+
+
+# -- diagnosis ---------------------------------------------------------------
+
+
+def _ctx(ledger):
+    return DiagnosisContext(
+        speed_monitor=None, metrics=None, node_manager=None, memory=ledger
+    )
+
+
+def test_hbm_pressure_operator_latches_and_rearms():
+    ledger = MemoryLedger()
+    op = HBMPressureOperator()
+    assert op.observe(_ctx(None)) == []
+    assert op.observe(_ctx(ledger)) == []  # empty ledger
+
+    ledger.record(0, **_snapshot_attrs(headroom=0.5))
+    ledger.record(1, **_snapshot_attrs(headroom=0.03))
+    actions = op.observe(_ctx(ledger))
+    assert len(actions) == 1
+    assert actions[0].action == ActionType.REPORT
+    assert actions[0].node_id == 1
+    assert "headroom" in actions[0].reason
+    assert op.observe(_ctx(ledger)) == []  # latched: one report per episode
+
+    # Recovery past floor + hysteresis re-arms; a fresh breach re-fires.
+    ledger.record(1, **_snapshot_attrs(headroom=0.4))
+    assert op.observe(_ctx(ledger)) == []
+    ledger.record(1, **_snapshot_attrs(headroom=0.02))
+    assert len(op.observe(_ctx(ledger))) == 1
+
+
+def test_hbm_pressure_operator_ignores_unknown_headroom():
+    ledger = MemoryLedger()
+    ledger.record(0, bytes_in_use=100.0, headroom_frac=-1.0)
+    op = HBMPressureOperator()
+    assert op.observe(_ctx(ledger)) == []
+
+
+# -- gauges + exposition lint ------------------------------------------------
+
+
+def _rendered_everything():
+    timeline = JobTimeline()
+    timeline.record(0, "step", kind="span", duration_s=0.1,
+                    attrs={"step": 1})
+    ledger = MemoryLedger()
+    ledger.record(0, **_snapshot_attrs())
+    calibration = CalibrationLedger()
+    calibration.observe("ck", "memory", 800.0, 640.0)
+    metrics = MetricsCollector()
+    metrics.collect(0, 10.0, 1.0, 2.0, 0.5,
+                    device_mem_max_gb=1.5, device_util_max=0.9)
+    return timeline.render_metrics(
+        speed_monitor=SpeedMonitor(), calibration=calibration,
+        memory=ledger, metrics=metrics,
+    )
+
+
+def test_hbm_gauges_render_with_pool_labels():
+    text = _rendered_everything()
+    assert "dlrover_hbm_bytes_in_use 500" in text
+    assert 'dlrover_hbm_pool_bytes{pool="params"} 500' in text
+    assert 'dlrover_hbm_pool_bytes{pool="kv_pool"} 0' in text
+    assert "dlrover_hbm_headroom_frac 0.5" in text
+    assert 'dlrover_host_device_mem_max_gb{node="0"} 1.5' in text
+    assert 'dlrover_host_device_util_max{node="0"} 0.9' in text
+    assert 'dlrover_calibration_ratio{phase="memory"}' in text
+
+
+def test_every_rendered_metric_has_help_and_type():
+    """Exposition lint: every sample the master renders must carry both
+    ``# HELP`` and ``# TYPE`` lines — half-documented gauges regress
+    silently otherwise."""
+    text = _rendered_everything()
+    helped, typed, sampled = set(), set(), set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+        elif line.strip():
+            name = line.split("{", 1)[0].split()[0]
+            sampled.add(name)
+    assert sampled, "lint ran against an empty exposition"
+    assert sampled - helped == set(), "samples missing # HELP"
+    assert sampled - typed == set(), "samples missing # TYPE"
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def _plane(hbm_floor=0.0, headroom=None):
+    from dlrover_tpu.master.http_plane import MetricsHTTPServer
+
+    ledger = MemoryLedger()
+    if headroom is not None:
+        ledger.record(0, **_snapshot_attrs(headroom=headroom))
+    servicer = MasterServicer(
+        timeline=JobTimeline(), memory_ledger=ledger
+    )
+    return MetricsHTTPServer(servicer, healthz_hbm_floor=hbm_floor)
+
+
+def test_healthz_hbm_floor_default_off():
+    plane = _plane(hbm_floor=0.0, headroom=0.01)
+    health = plane.healthz()
+    assert health["ok"] is True  # floor off: low headroom reported, not fatal
+    assert health["hbm_headroom_frac"] == pytest.approx(0.01)
+
+
+def test_healthz_flips_below_hbm_floor():
+    assert _plane(hbm_floor=0.05, headroom=0.01).healthz()["ok"] is False
+    assert _plane(hbm_floor=0.05, headroom=0.2).healthz()["ok"] is True
+    # Unknown headroom (no allocator stats) never flips health.
+    assert _plane(hbm_floor=0.05, headroom=None).healthz()["ok"] is True
+
+
+def test_memory_endpoint_payload():
+    plane = _plane(headroom=0.5)
+    payload = json.loads(plane.memory_json())
+    assert payload["ledger"]["nodes"] == 1
+    assert payload["nodes"]["0"]["bytes_in_use"] == 500.0
+
+
+# -- OOM forensics -----------------------------------------------------------
+
+
+def test_is_oom_error_matches_resource_exhausted():
+    assert mp.is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: foo"))
+    assert mp.is_oom_error(ValueError("Out of memory while allocating"))
+    assert not mp.is_oom_error(ValueError("shape mismatch"))
+
+
+def test_oom_postmortem_classifies_top_buffers(tmp_path):
+    big = jnp.ones((256, 64), jnp.float32)
+    small = jnp.ones((4, 4), jnp.float32)
+    mp.registry().register("params", "t.params", lambda: [big])
+    mp.registry().register("kv_pool", "t.kv", lambda: [small])
+    path = mp.dump_oom_postmortem(
+        str(tmp_path), error=RuntimeError("RESOURCE_EXHAUSTED: hbm"),
+        cache_key="ck", top_n=5,
+    )
+    with open(path) as f:
+        dump = json.load(f)
+    assert "RESOURCE_EXHAUSTED" in dump["error"]
+    assert dump["cache_key"] == "ck"
+    assert dump["top"][0]["pool"] == "params"  # largest-first
+    assert dump["top"][0]["nbytes"] == big.nbytes
+    assert dump["pools_b"]["kv_pool"] == small.nbytes
+    assert dump["rows_total"] == 2
+
+
+def test_oom_postmortem_never_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(mp._REGISTRY, "rows", lambda: 1 / 0)
+    assert mp.dump_oom_postmortem(str(tmp_path), error=None) is None

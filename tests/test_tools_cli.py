@@ -647,3 +647,157 @@ def test_train_lm_timeline_flag(tmp_path, monkeypatch):
     ]
     assert len(steps) == 3
     assert sorted(e["args"]["step"] for e in steps) == [1, 2, 3]
+
+
+def test_memory_bench_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "memory_bench.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--out" in out.stdout and "--grad-accum" in out.stdout
+    assert "--live-steps" in out.stdout and "--serve-slots" in out.stdout
+
+
+def _memory_result():
+    """A MEMORY.json-shaped dict that passes every gate check — the
+    single-mutation matrix below breaks one leg at a time."""
+    return {
+        "param_opt": {
+            "measured_params_b": 482304, "measured_opt_b": 964612,
+            "modeled_params_b": 482304, "modeled_opt_b": 964612,
+        },
+        "zero1": {"legs": [
+            {"dp": 1, "measured_opt_b": 964612, "modeled_opt_b": 0},
+            {"dp": 2, "measured_opt_b": 482308, "modeled_opt_b": 482308},
+            {"dp": 4, "measured_opt_b": 241156, "modeled_opt_b": 241156},
+        ]},
+        "kv": {"legs": [
+            {"tp": 1, "measured_kv_b": 65544},
+            {"tp": 2, "measured_kv_b": 32776},
+        ]},
+        "accum": {"temp_delta_b": 241152, "accum_half_b": 241152},
+        "live": {
+            "events": 4,
+            "ledger": {"bytes_in_use": 5789840.0,
+                       "pool_params_b": 482304.0,
+                       "pool_opt_state_b": 964612.0},
+            "gauges_rendered": True,
+            "calibration_memory_ratio": 4.0,
+            "retraces": 0,
+        },
+        "postmortem": {"rows": 8, "top_pool": "params",
+                       "pools": ["params", "opt_state", "other"]},
+    }
+
+
+def test_memory_bench_gate_predicate():
+    """The MEMORY.json ok gate is a pure predicate; each certification
+    leg fails as its own named check."""
+    import copy
+
+    tool = _load_module(
+        os.path.join(REPO, "tools", "memory_bench.py"), "_memory_bench"
+    )
+    ok, failed = tool.evaluate_memory_gate(_memory_result())
+    assert ok and failed == []
+
+    def mutate(fn):
+        result = copy.deepcopy(_memory_result())
+        fn(result)
+        return tool.evaluate_memory_gate(result)
+
+    ok, failed = mutate(
+        lambda r: r["param_opt"].update(measured_params_b=300000))
+    assert not ok and failed == ["params_match_shape_model"]
+
+    ok, failed = mutate(
+        lambda r: r["param_opt"].update(measured_opt_b=300000))
+    assert not ok and failed == ["opt_state_matches_shape_model"]
+
+    # Not falling: dp=4 measures the full replicated bytes (measured and
+    # modeled agree, so only the 1/dp law fails).
+    ok, failed = mutate(lambda r: r["zero1"]["legs"][2].update(
+        measured_opt_b=964612, modeled_opt_b=964612))
+    assert not ok and failed == ["zero1_opt_bytes_fall_with_dp"]
+
+    ok, failed = mutate(lambda r: r["zero1"]["legs"][2].update(
+        modeled_opt_b=400000))
+    assert not ok and failed == ["zero1_measured_matches_model"]
+
+    ok, failed = mutate(lambda r: r["kv"]["legs"][1].update(
+        measured_kv_b=60000))
+    assert not ok and failed == ["kv_pool_falls_with_tp"]
+
+    ok, failed = mutate(lambda r: r["accum"].update(temp_delta_b=100000))
+    assert not ok and failed == ["accum_bf16_halves_accumulator"]
+
+    ok, failed = mutate(lambda r: r["live"].update(events=0))
+    assert not ok and failed == ["live_events_flow"]
+
+    ok, failed = mutate(lambda r: r["live"].update(gauges_rendered=False))
+    assert not ok and failed == ["live_gauges_render"]
+
+    ok, failed = mutate(
+        lambda r: r["live"].update(calibration_memory_ratio=0.0))
+    assert not ok and failed == ["calibration_learned_memory_ratio"]
+
+    ok, failed = mutate(lambda r: r["live"].update(retraces=2))
+    assert not ok and failed == ["steady_state_no_retrace"]
+
+    ok, failed = mutate(lambda r: r["postmortem"].update(rows=0))
+    assert not ok and failed == ["postmortem_classified"]
+
+
+def test_memory_json_artifact_certified():
+    """The committed MEMORY.json must be a real certified run: the gate
+    re-evaluates to ok on the booked numbers, ZeRO-1 opt bytes fall with
+    dp, and the live leg held zero steady-state retraces."""
+    path = os.path.join(REPO, "MEMORY.json")
+    with open(path) as f:
+        result = json.load(f)
+    tool = _load_module(
+        os.path.join(REPO, "tools", "memory_bench.py"), "_memory_bench2"
+    )
+    ok, failed = tool.evaluate_memory_gate(result)
+    assert ok, f"MEMORY.json fails its own gate: {failed}"
+    assert result["ok"] is True
+    opt = [leg["measured_opt_b"] for leg in result["zero1"]["legs"]]
+    assert opt[0] > opt[1] > opt[2]
+    assert result["live"]["retraces"] == 0
+    assert result["accum"]["temp_delta_b"] > 0
+
+
+def test_metrics_scrape_memory_endpoint(monkeypatch, capsys):
+    """The scrape CLI probes /memory against a live plane holding a
+    populated MemoryLedger."""
+    from dlrover_tpu.master.http_plane import MetricsHTTPServer
+    from dlrover_tpu.master.memory_ledger import MemoryLedger
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.timeline import JobTimeline
+
+    ledger = MemoryLedger()
+    ledger.record(0, bytes_in_use=800.0, peak_bytes=900.0,
+                  limit_bytes=1000.0, headroom_frac=0.2,
+                  pool_params_b=500.0)
+    plane = MetricsHTTPServer(
+        MasterServicer(timeline=JobTimeline(), memory_ledger=ledger),
+        host="127.0.0.1", port=0,
+    )
+    port = plane.start()
+    tool = _load_module(
+        os.path.join(REPO, "tools", "metrics_scrape.py"),
+        "_metrics_scrape_mem",
+    )
+    monkeypatch.setattr(sys, "argv", [
+        "metrics_scrape.py", "--url", f"http://127.0.0.1:{port}",
+    ])
+    try:
+        assert tool.main() == 0
+    finally:
+        plane.stop()
+    report = capsys.readouterr().out
+    assert "memory: nodes=1 bytes_in_use=800 headroom=0.200" in report
+    assert "hbm_headroom=0.2" in report
+    assert "FAILED" not in report

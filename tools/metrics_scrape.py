@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Smoke-scrape the master's HTTP observability plane.
 
-Hits ``/healthz``, ``/metrics`` and (optionally) ``/timeline`` on a
-running master's ``--metrics-port`` and prints a one-line verdict per
-endpoint — the 20-second "is the scrape surface actually up and sane"
+Hits ``/healthz``, ``/metrics``, ``/memory`` and (optionally)
+``/timeline`` on a running master's ``--metrics-port`` and prints a
+one-line verdict per endpoint — the 20-second "is the scrape surface actually up and sane"
 check an operator (or CI) runs before pointing a real Prometheus at it.
 
     python tools/metrics_scrape.py --url http://127.0.0.1:8080
@@ -43,7 +43,8 @@ def scrape(url: str, timeout: float, timeline_out: str = "") -> int:
             f"rdzv_round={health.get('rdzv_round')} "
             f"live={health.get('live_nodes')} "
             f"running={health.get('running_nodes')} "
-            f"quarantined={health.get('quarantined')}"
+            f"quarantined={health.get('quarantined')} "
+            f"hbm_headroom={health.get('hbm_headroom_frac')}"
         )
     except Exception as e:  # noqa: BLE001 - each probe reports and moves on
         print(f"healthz: FAILED ({e})", file=sys.stderr)
@@ -61,6 +62,18 @@ def scrape(url: str, timeout: float, timeline_out: str = "") -> int:
               f"({len(text.splitlines())} lines)")
     except Exception as e:  # noqa: BLE001
         print(f"metrics: FAILED ({e})", file=sys.stderr)
+        failures += 1
+
+    try:
+        memory = json.loads(_get(f"{base}/memory", timeout))
+        ledger = memory.get("ledger", {})
+        print(
+            f"memory: nodes={ledger.get('nodes', 0):.0f} "
+            f"bytes_in_use={ledger.get('bytes_in_use', 0):.0f} "
+            f"headroom={ledger.get('headroom_frac', -1.0):.3f}"
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"memory: FAILED ({e})", file=sys.stderr)
         failures += 1
 
     if timeline_out:

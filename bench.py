@@ -20,6 +20,7 @@ See PROFILE.md for the measured step breakdown behind the chosen config.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import subprocess
@@ -40,6 +41,12 @@ CE_CHUNKS = 0           # after the r3 kernel work the plain fused CE beats
                         # the chunked scan at this shape (PROFILE.md table)
 WARMUP_STEPS = 2
 MEASURE_STEPS = 10
+# MoE sweep entry: iso-FLOP with the dense baseline — top_k experts of
+# (dense d_ff / top_k) width activate per token, so the MLP matmul FLOPs
+# per token match the dense entry exactly; the delta is routing + dispatch.
+MOE_EXPERTS = 8
+MOE_TOP_K = 2
+MOE_CAPACITY = 1.25
 REFERENCE_HFU = 0.656   # Llama2-7B FSDP, BASELINE.md best utilization claim
 
 _PEAK_BF16_TFLOPS = {
@@ -206,6 +213,7 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
                         grad_accum: int = 1,
                         reduce_quant: str = "none",
                         zero1: bool = False, overlap: bool = False,
+                        moe: bool = False,
                         scaling: "dict | None" = None) -> None:
     """Relative CPU-mesh metric when the TPU backend is wedged.
 
@@ -226,6 +234,14 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
     from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
     from dlrover_tpu.trainer import train_lib
 
+    moe_kw = {}
+    if moe:
+        # Iso-FLOP with the dense fallback shape: top_k=2 experts of half
+        # the dense d_ff (4*d_model) activate per token.
+        moe_kw = dict(
+            num_experts=4, top_k=2, capacity_factor=1.25,
+            d_ff=CPU_FALLBACK_D_MODEL * 2,
+        )
     config = TransformerConfig(
         vocab_size=CPU_FALLBACK_VOCAB,
         num_layers=CPU_FALLBACK_LAYERS,
@@ -233,6 +249,7 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
         num_heads=CPU_FALLBACK_HEADS,
         max_seq_len=CPU_FALLBACK_SEQ,
         dtype=jnp.float32,
+        **moe_kw,
     )
     model = TransformerLM(config)
     mesh = build_mesh(ParallelConfig(data=-1))
@@ -283,6 +300,14 @@ def _cpu_fallback_bench(cause: str, entry: str = "baseline",
         detail["grad_accum"] = grad_accum
         detail["reduce_quant"] = reduce_quant
         detail["zero1"] = bool(train.zero1)
+    if moe:
+        detail["moe"] = {
+            "num_experts": config.num_experts,
+            "top_k": config.top_k,
+            "capacity_factor": config.capacity_factor,
+            "dispatch": config.moe_dispatch,
+            "iso_flop_dense_d_ff": config.resolved_d_ff * config.top_k,
+        }
     out = {
         "metric": _entry_metric(entry),
         "value": round(global_batch * CPU_FALLBACK_SEQ / step_time, 2),
@@ -314,11 +339,15 @@ BENCH_ENTRIES = (
     ("zero1", {"grad_accum": 4, "reduce_quant": "none", "zero1": True}),
     ("zero1+overlap", {"grad_accum": 4, "reduce_quant": "none",
                        "zero1": True, "overlap": True}),
+    # MoE at the dense entry's activated FLOPs (MOE_* constants): value
+    # SHOULD track baseline; the gap is routing + dispatch overhead.
+    ("moe", {"grad_accum": 1, "reduce_quant": "none", "moe": True}),
 )
 
 
 def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
                zero1: bool = False, overlap: bool = False,
+               moe: bool = False,
                scaling: "dict | None" = None) -> None:
     from dlrover_tpu.auto import est_comm_time, pick_grad_accum
     from dlrover_tpu.models.gpt2 import gpt2_config
@@ -335,6 +364,16 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
         remat=REMAT,
         attention_impl="flash",
     )
+    if moe:
+        # Iso-FLOP with the dense baseline: top_k experts of
+        # (dense d_ff / top_k) width per token, GSPMD einsum dispatch
+        # (the expert axis is 1 on a single-chip bench).
+        config = dataclasses.replace(
+            config, num_experts=MOE_EXPERTS, top_k=MOE_TOP_K,
+            capacity_factor=MOE_CAPACITY,
+            d_ff=config.resolved_d_ff // MOE_TOP_K,
+            moe_dispatch="einsum",
+        )
     model = TransformerLM(config)
     parallel = ParallelConfig(data=-1, fsdp=1)
     mesh = build_mesh(parallel)
@@ -376,8 +415,16 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
     tokens_per_sec = tokens_per_step * MEASURE_STEPS / dt
     tokens_per_sec_chip = tokens_per_sec / n_chips
 
-    ftok = flops_per_token(config)
-    ftok_hw = ftok + recompute_flops_per_token(config, REMAT)
+    # MoE FLOPs accounting uses the activated dense-equivalent shape
+    # (num_params counts ALL experts; only top_k of them run per token).
+    flops_cfg = config
+    if moe:
+        flops_cfg = dataclasses.replace(
+            config, num_experts=0,
+            d_ff=config.resolved_d_ff * config.top_k,
+        )
+    ftok = flops_per_token(flops_cfg)
+    ftok_hw = ftok + recompute_flops_per_token(flops_cfg, REMAT)
     peak = chip_peak_tflops()
     mfu = tokens_per_sec_chip * ftok / 1e12 / peak
     hfu = tokens_per_sec_chip * ftok_hw / 1e12 / peak
@@ -422,6 +469,25 @@ def _tpu_bench(entry: str, grad_accum: int, reduce_quant: str,
                 est_comm_time(config, parallel, "int8"), 6
             ),
         })
+    if moe:
+        from dlrover_tpu.parallel.quantized_collectives import a2a_wire_bytes
+
+        # Dispatch wire pricing next to the measurement: the per-device
+        # capacity-padded expert tensor on both formats (what an expert
+        # axis would move; PROFILE.md round 19's cost model).
+        elems = int(
+            config.capacity_factor * config.top_k
+            * PER_CHIP_BATCH * SEQ_LEN * config.d_model
+        )
+        detail["moe"] = {
+            "num_experts": config.num_experts,
+            "top_k": config.top_k,
+            "capacity_factor": config.capacity_factor,
+            "dispatch": config.moe_dispatch,
+            "iso_flop_dense_d_ff": config.resolved_d_ff * config.top_k,
+            "a2a_wire_bytes_fp32": a2a_wire_bytes(elems, "none"),
+            "a2a_wire_bytes_int8": a2a_wire_bytes(elems, "int8"),
+        }
     if zero1:
         detail["zero1"] = bool(train.zero1)
         if overlap:

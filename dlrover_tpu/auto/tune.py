@@ -426,8 +426,32 @@ def _estimate(
         coll_b += 3 * n * 2 / shard * (p.fsdp - 1) / p.fsdp
     if p.data > 1:
         coll_b += 2 * n * 2 / shard * (p.data - 1) / p.data
-    if p.seq > 1 or p.expert > 1:
+    if p.seq > 1:
         coll_b += 4 * tokens_local * config.d_model * 2
+    if p.expert > 1:
+        if config.num_experts:
+            # MoE a2a dispatch: the capacity-padded expert tensor rides
+            # the expert ring twice per direction per layer, int8 wire
+            # when the model asks for it (a2a_wire_bytes prices the
+            # payload + block-scale format exactly).
+            from dlrover_tpu.parallel.quantized_collectives import (
+                a2a_wire_bytes,
+            )
+
+            quant = (
+                "int8" if config.moe_dispatch == "a2a_int8" else "none"
+            )
+            elems = int(
+                config.capacity_factor * config.top_k
+                * tokens_local * config.d_model
+            )
+            coll_b += (
+                4 * config.num_layers
+                * a2a_wire_bytes(elems, quant)
+                * (p.expert - 1) / p.expert
+            )
+        else:
+            coll_b += 4 * tokens_local * config.d_model * 2
     if p.tensor > 1:
         coll_b += 4 * tokens_local * config.d_model * 2 * config.num_layers
     # DCN-crossing gradient traffic: int8-quantized collectives
@@ -576,6 +600,8 @@ def est_comm_time(
     bucket_mb: float = 0.0,
     grad_accum: int = 1,
     calibration=None,
+    moe_tokens_local: int = 0,
+    moe_dispatch_quant: str = "none",
 ) -> float:
     """Seconds of *exposed* wire for the data-parallel gradient reduce.
 
@@ -589,6 +615,17 @@ def est_comm_time(
     under ZeRO-1 the updated *params* riding back — stays full precision;
     the quantize/dequantize passes add ~2 HBM sweeps over the sharded
     gradient tree.  Zero when data=1: there is no reduce to price.
+
+    ``moe_tokens_local > 0`` additionally prices the MoE dispatch
+    transport when the mesh has an expert axis: each MoE layer moves the
+    capacity-padded expert tensor ``cf·k·tokens_local·d_model`` over the
+    expert ring twice per direction (dispatch + combine, forward and
+    backward — the all-to-all's adjoint is the inverse exchange on the
+    same wire), with only ``(ep-1)/ep`` of the payload leaving the chip.
+    ``moe_dispatch_quant="int8"`` prices the quantized wire format of
+    ``quantized_all_to_all`` (int8 payload + fp32 block scales) via
+    :func:`a2a_wire_bytes`.  The MoE legs are never hidden by the
+    overlap engine — dispatch sits on the layer's critical path.
 
     ``overlap=True`` prices the overlap engine's schedule
     (``parallel/overlap.py``): the reduce-scatter runs once per
@@ -605,8 +642,21 @@ def est_comm_time(
     """
     _, hbm_bw, _, ici_bw = chip_specs()
     p = parallel
+    ep = max(p.expert, 1)
+    moe_t = 0.0
+    if moe_tokens_local > 0 and config.num_experts and ep > 1:
+        from dlrover_tpu.parallel.quantized_collectives import a2a_wire_bytes
+
+        elems = int(
+            config.capacity_factor * config.top_k
+            * moe_tokens_local * config.d_model
+        )
+        leg = a2a_wire_bytes(elems, moe_dispatch_quant) * (ep - 1) / ep
+        # dispatch + combine, forward + backward = 4 legs per MoE layer,
+        # once per microbatch.
+        moe_t = 4 * config.num_layers * max(1, grad_accum) * leg / ici_bw
     if p.data <= 1:
-        return 0.0
+        return moe_t
     n = config.num_params()
     shard = p.fsdp * p.tensor * p.pipe * max(p.expert, 1)
     leg_b = n * 2 / shard * (p.data - 1) / p.data
@@ -618,7 +668,7 @@ def est_comm_time(
         sweep_t = 0.0
     ag_t = leg_b / ici_bw                 # full-precision gather leg
     if not overlap:
-        return rs_t + ag_t + sweep_t
+        return rs_t + ag_t + sweep_t + moe_t
     hidden = OVERLAP_HIDDEN_DEFAULT
     if calibration is not None:
         measured = getattr(calibration, "overlap", lambda: 0.0)()
@@ -640,6 +690,7 @@ def est_comm_time(
     return (
         rs_exposed + ag_exposed + sweep_t * accum
         + fill_drain + n_buckets * BUCKET_LAUNCH_S
+        + moe_t
     )
 
 

@@ -369,6 +369,17 @@ class MasterServicer:
                         "unparseable serve event from %d: %r",
                         node, attrs,
                     )
+            elif self.speed_monitor is not None and name == "moe":
+                # Router-health snapshot (gate entropy, capacity drops,
+                # per-expert load): feeds the moe ledger behind the
+                # dlrover_moe_* gauges.
+                try:
+                    self.speed_monitor.record_moe(node, **attrs)
+                except (TypeError, ValueError):
+                    logger.warning(
+                        "unparseable moe event from %d: %r",
+                        node, attrs,
+                    )
             elif self.speed_monitor is not None and name == "embed":
                 # Embedding-plane stats snapshot: feeds the embed ledger
                 # behind the dlrover_embed_* gauges (rows owned, cache

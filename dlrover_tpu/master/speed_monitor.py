@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 
 class SpeedMonitor:
@@ -94,6 +94,11 @@ class SpeedMonitor:
         # ``dlrover_embed_*`` gauges read the aggregate.
         self._embed_stats: Dict[int, Dict[str, float]] = {}
         self._embed_events = 0
+        # "moe" telemetry events: each reporter's newest router-health
+        # snapshot (gate entropy, capacity-drop fraction, per-expert
+        # load) — the ``dlrover_moe_*`` gauges read the aggregate.
+        self._moe_stats: Dict[int, Dict[str, Any]] = {}
+        self._moe_events = 0
 
     def collect_global_step(
         self, step: int, timestamp: Optional[float] = None, tokens: int = 0
@@ -273,6 +278,70 @@ class SpeedMonitor:
                 "spill_bytes": float(spill_bytes),
                 "hit_rate": float(hit_rate),
                 "rows_per_s": float(rows_per_s),
+            }
+
+    def record_moe(
+        self,
+        node_id: int = 0,
+        *,
+        step: float = 0.0,
+        entropy: float = 0.0,
+        drop_fraction: float = 0.0,
+        experts: float = 0.0,
+        top_k: float = 0.0,
+        load: Any = "[]",
+        **_ignored,
+    ):
+        """A trainer's router-health snapshot (its ``moe`` telemetry
+        event).  Newest-wins per reporting node; ``load`` arrives as a
+        JSON array string of per-expert load fractions (wire attrs stay
+        scalar-ish); unknown attrs are ignored so the trainer can grow
+        the event without breaking older masters."""
+        if isinstance(load, str):
+            import json
+
+            load = json.loads(load)
+        with self._lock:
+            self._moe_events += 1
+            self._moe_stats[node_id] = {
+                "step": float(step),
+                "entropy": float(entropy),
+                "drop_fraction": float(drop_fraction),
+                "experts": float(experts),
+                "top_k": float(top_k),
+                "load": [float(v) for v in load],
+            }
+
+    def moe_ledger(self) -> Dict[str, Any]:
+        """Router-health aggregate: entropy/drop average across reporters
+        (each books its own replica's gate view), expert geometry takes
+        the max, and per-expert load averages elementwise across the
+        reporters that carry the full-width vector."""
+        with self._lock:
+            stats = list(self._moe_stats.values())
+            n = len(stats)
+            experts = max((s["experts"] for s in stats), default=0.0)
+            loads = [
+                s["load"] for s in stats
+                if len(s["load"]) == int(experts) and experts
+            ]
+            load = [
+                sum(vec[i] for vec in loads) / len(loads)
+                for i in range(int(experts))
+            ] if loads else []
+            return {
+                "moe_events": float(self._moe_events),
+                "reporters": float(n),
+                "step": max((s["step"] for s in stats), default=0.0),
+                "entropy": (
+                    sum(s["entropy"] for s in stats) / n if n else 0.0
+                ),
+                "drop_fraction": (
+                    sum(s["drop_fraction"] for s in stats) / n if n else 0.0
+                ),
+                "experts": experts,
+                "top_k": max((s["top_k"] for s in stats), default=0.0),
+                "load": load,
             }
 
     def embed_ledger(self) -> Dict[str, float]:

@@ -351,6 +351,32 @@ class JobTimeline:
                   "cold rows spilled to host-disk tiers, in bytes")
             gauge("dlrover_embed_rows_per_s", embed["rows_per_s"],
                   "embedding rows served/s (newest reported snapshot)")
+            moe = speed_monitor.moe_ledger()
+            gauge("dlrover_moe_gate_entropy", moe["entropy"],
+                  "mean per-token router entropy in nats (mean of "
+                  "reporters; ln(E) = uniform routing, 0 = collapsed)")
+            gauge("dlrover_moe_capacity_drop_fraction",
+                  moe["drop_fraction"],
+                  "fraction of token-choices dropped at expert capacity "
+                  "(0 on the dropless grouped path)")
+            gauge("dlrover_moe_experts", moe["experts"],
+                  "expert count of the reported MoE model")
+            gauge("dlrover_moe_top_k", moe["top_k"],
+                  "router choices per token (top-k)")
+            gauge("dlrover_moe_reporters", moe["reporters"],
+                  "trainers that have reported router-health snapshots")
+            lines.append(
+                "# HELP dlrover_moe_expert_load fraction of kept "
+                "token-choices routed to each expert (mean of reporters; "
+                "1/E = perfectly balanced)"
+            )
+            lines.append("# TYPE dlrover_moe_expert_load gauge")
+            if moe["load"]:
+                for i, frac in enumerate(moe["load"]):
+                    gauge("dlrover_moe_expert_load", frac,
+                          labels=f'{{expert="{i}"}}')
+            else:
+                gauge("dlrover_moe_expert_load", 0)
             sdc = speed_monitor.sdc_ledger()
             gauge("dlrover_sdc_checks_total", sdc["checks"],
                   "cross-replica state-digest votes performed")

@@ -55,7 +55,8 @@ class TransformerConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
-    moe_dispatch: str = "einsum"   # "einsum" (EP-shardable) | "grouped"
+    moe_dispatch: str = "einsum"   # "einsum" | "a2a" | "a2a_int8"
+                                   # (EP-shardable) | "grouped" (EP=1 only)
     # numerics / execution
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -377,7 +378,10 @@ class TransformerLM(nn.Module):
         elif cfg.scan_layers:
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "cache": 0},
+                # "intermediates" carries the MoE router stats each layer
+                # sows — stacked on a leading layer axis when harvested
+                # with mutable=["intermediates"], absent otherwise.
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers,

@@ -23,6 +23,7 @@ asserted by the tests).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Tuple
 
@@ -299,6 +300,119 @@ def quantized_all_gather(
     out = shards[:, : flat.size].reshape((n,) + moved.shape)
     out = out.reshape((n * moved.shape[0],) + moved.shape[1:])
     return jnp.moveaxis(out, 0, dim).astype(orig_dtype)
+
+
+def a2a_wire_bytes(
+    n_elems: int, quant: str = "none", *, block: int = 256,
+    elem_bytes: int = 4,
+) -> int:
+    """Modeled wire bytes for ONE all-to-all leg over ``n_elems`` elements.
+
+    The pure pricing twin of :func:`quantized_all_to_all`: the int8 wire
+    carries 1 byte/element plus a 4-byte fp32 scale per quant block, vs
+    ``elem_bytes`` (4 for fp32) on the plain transport.  ``auto.tune``'s
+    ``est_comm_time`` and the MoE bench price the dispatch legs with this
+    so the modeled discount and the implemented wire format cannot drift
+    apart.
+    """
+    if quant == "int8":
+        return n_elems + (-(-n_elems // block)) * 4
+    return n_elems * elem_bytes
+
+
+def quantized_all_to_all(
+    x: jax.Array,
+    axis_name: str,
+    *,
+    split_axis: int = 0,
+    concat_axis: int = 0,
+    block: int = 256,
+) -> jax.Array:
+    """All-to-all ``x`` over ``axis_name`` on the int8 wire format.
+
+    The MoE dispatch transport: member ``i`` splits ``x`` into ``n``
+    chunks along ``split_axis``, block-quantizes each chunk ONCE at the
+    source, exchanges int8 payload + fp32 scales (chunk ``j`` to member
+    ``j``), and every member dequantizes its ``n`` received chunks and
+    concatenates them along ``concat_axis`` in member order — exactly
+    ``jax.lax.all_to_all(..., tiled=True)`` semantics with ~(1 + 4/block)
+    bytes/element on the wire instead of 4 (see :func:`a2a_wire_bytes`).
+
+    Like the other quantized collectives this is dtype-preserving, pads
+    partial blocks at the source and slices after dequant, and is the
+    identity when the axis has one member (no wire → no quantization).
+    When ``split_axis == concat_axis`` the exchange is an involution: a
+    second call routes every chunk back to its source, which is how the
+    MoE layer uses it (dispatch leg out, combine leg back).
+
+    Differentiable: the permutation's exact adjoint is the inverse
+    exchange (``split_axis``/``concat_axis`` swapped), and the cotangent
+    rides the SAME int8 wire — the straight-through estimator every
+    quantized-collective training scheme uses, so forward and backward
+    dispatch legs both get the wire discount.
+    """
+    return _qa2a(x, axis_name, split_axis, concat_axis, block)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _qa2a(x, axis_name, split_axis, concat_axis, block):
+    return _qa2a_impl(x, axis_name, split_axis, concat_axis, block)
+
+
+def _qa2a_fwd(x, axis_name, split_axis, concat_axis, block):
+    return _qa2a_impl(x, axis_name, split_axis, concat_axis, block), None
+
+
+def _qa2a_bwd(axis_name, split_axis, concat_axis, block, _res, g):
+    # Inverse permutation (roles swapped) on the quantized wire;
+    # straight-through the rounding.
+    return (_qa2a_impl(g, axis_name, concat_axis, split_axis, block),)
+
+
+_qa2a.defvjp(_qa2a_fwd, _qa2a_bwd)
+
+
+def _qa2a_impl(x, axis_name, split_axis, concat_axis, block):
+    n = _axis_size(axis_name)
+    if n == 1:
+        return x
+    if x.shape[split_axis] % n:
+        raise ValueError(
+            f"all-to-all split axis {split_axis} (size "
+            f"{x.shape[split_axis]}) must divide by the {n}-member axis "
+            f"{axis_name!r}"
+        )
+    orig_dtype = x.dtype
+    moved = jnp.moveaxis(x, split_axis, 0)
+    chunk_shape = (moved.shape[0] // n,) + moved.shape[1:]
+    chunks = moved.astype(jnp.float32).reshape(n, -1)
+    csize = chunks.shape[1]
+    padded = -(-csize // block) * block
+    chunks = jnp.pad(chunks, ((0, 0), (0, padded - csize)))
+    # One quantization round at the source; per-chunk block alignment
+    # holds because each row pads to a whole number of blocks.
+    q, s = _block_quant(chunks.reshape(-1), block)
+    q_recv = jax.lax.all_to_all(
+        q.reshape(n, padded), axis_name, 0, 0, tiled=False
+    )
+    s_recv = jax.lax.all_to_all(
+        s.reshape(n, padded // block), axis_name, 0, 0, tiled=False
+    )
+    deq = jax.vmap(lambda qq, ss: _block_dequant(qq, ss, block))(
+        q_recv, s_recv
+    )
+    pieces = deq[:, :csize].reshape((n,) + chunk_shape)
+    # Restore each piece to the original dim order, then merge the member
+    # dim into ``concat_axis`` (row-major reshape == concat in member
+    # order, matching the tiled all_to_all contract).
+    pieces = jnp.moveaxis(pieces, 1, 1 + split_axis)
+    out = jnp.moveaxis(pieces, 0, concat_axis)
+    shape = (
+        out.shape[:concat_axis]
+        + (out.shape[concat_axis] * out.shape[concat_axis + 1],)
+        + out.shape[concat_axis + 2:]
+    )
+    return out.reshape(shape).astype(orig_dtype)
 
 
 def quantized_process_allgather(local_tree, block: int = 256):

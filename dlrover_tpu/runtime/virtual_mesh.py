@@ -57,12 +57,26 @@ class VirtualMesh:
     mesh: Any  # jax.sharding.Mesh
     logical_world: int
     physical_world: int
+    # Expert-axis worlds (PR 19): the expert plane folds with the SAME
+    # ``s % P`` rule as the data plane, independently.  ``expert_logical``
+    # is the job's reference expert-shard count (fixed, like
+    # ``logical_world``); ``expert_physical`` is how many live expert
+    # groups currently host them.  Defaults of 1 keep every pre-MoE
+    # constructor and resize path byte-identical.
+    expert_logical: int = 1
+    expert_physical: int = 1
 
     def __post_init__(self):
         if self.logical_world < 1 or self.physical_world < 1:
             raise ValueError(
                 f"worlds must be >= 1, got logical={self.logical_world} "
                 f"physical={self.physical_world}"
+            )
+        if self.expert_logical < 1 or self.expert_physical < 1:
+            raise ValueError(
+                f"expert worlds must be >= 1, got "
+                f"logical={self.expert_logical} "
+                f"physical={self.expert_physical}"
             )
 
     # -- geometry --------------------------------------------------------------
@@ -73,13 +87,24 @@ class VirtualMesh:
         return -(-self.logical_world // self.physical_world)
 
     @property
+    def expert_fold(self) -> int:
+        """Max logical expert shards any live expert group hosts
+        (ceil(E_L/E_P)) — the expert plane's :attr:`fold`."""
+        return -(-self.expert_logical // self.expert_physical)
+
+    @property
     def logical_shape(self) -> Tuple[int, ...]:
         """The resize-invariant program shape: the per-process mesh with
-        its outermost (data) axis scaled by the logical world.  Constant
-        across every resize — the bit ``train_cache_key`` carries so one
-        program family serves all folds."""
-        shape = tuple(self.mesh.devices.shape)
-        return (shape[0] * self.logical_world,) + shape[1:]
+        its outermost (data) axis scaled by the logical world and its
+        expert axis scaled by the logical expert world.  Constant across
+        every resize — the bit ``train_cache_key`` carries so one program
+        family serves all folds (data AND expert)."""
+        shape = list(self.mesh.devices.shape)
+        shape[0] *= self.logical_world
+        names = tuple(getattr(self.mesh, "axis_names", ()))
+        if "expert" in names:
+            shape[names.index("expert")] *= self.expert_logical
+        return tuple(shape)
 
     def owner(self, shard: int) -> int:
         """Physical member hosting logical shard ``shard``."""
@@ -92,21 +117,56 @@ class VirtualMesh:
             range(rank, self.logical_world, self.physical_world)
         ) if rank < self.physical_world else ()
 
+    def expert_owner(self, shard: int) -> int:
+        """Live expert group hosting logical expert shard ``shard`` —
+        the same ``s % P`` rule on the expert plane."""
+        return shard_owner(shard, self.expert_physical)
+
+    def owned_expert_shards(self, rank: int) -> Tuple[int, ...]:
+        """Logical expert shards folded onto expert group ``rank``."""
+        return tuple(
+            range(rank, self.expert_logical, self.expert_physical)
+        ) if rank < self.expert_physical else ()
+
     def with_world(self, new_world: int) -> "VirtualMesh":
         """The same logical mesh folded onto ``new_world`` members."""
         return dataclasses.replace(
             self, physical_world=max(1, int(new_world))
         )
 
-    def relayout_plan(self, new_world: int) -> List[Dict[str, int]]:
+    def with_expert_world(self, new_expert_world: int) -> "VirtualMesh":
+        """The same logical expert plane folded onto ``new_expert_world``
+        live expert groups (the data fold is untouched)."""
+        return dataclasses.replace(
+            self, expert_physical=max(1, int(new_expert_world))
+        )
+
+    def relayout_plan(
+        self, new_world: int, new_expert_world: int = 0
+    ) -> List[Dict[str, int]]:
         """Shard moves a resize implies: [{shard, src, dst}] for every
-        logical shard whose owner changes (diagnostics / drill booking)."""
+        logical shard whose owner changes (diagnostics / drill booking).
+        Passing ``new_expert_world`` > 0 additionally plans the expert
+        plane's re-fold; its entries carry ``axis: "expert"`` so booking
+        can split the two planes (data entries keep their legacy shape)."""
         target = self.with_world(new_world)
-        return [
+        plan: List[Dict[str, int]] = [
             {"shard": s, "src": self.owner(s), "dst": target.owner(s)}
             for s in range(self.logical_world)
             if self.owner(s) != target.owner(s)
         ]
+        if new_expert_world > 0:
+            etarget = self.with_expert_world(new_expert_world)
+            plan.extend(
+                {
+                    "axis": "expert", "shard": s,
+                    "src": self.expert_owner(s),
+                    "dst": etarget.expert_owner(s),
+                }
+                for s in range(self.expert_logical)
+                if self.expert_owner(s) != etarget.expert_owner(s)
+            )
+        return plan
 
     # -- invariance keys -------------------------------------------------------
 

@@ -243,6 +243,12 @@ class ElasticTrainer:
         self._digest_fn = None
         self._digest_train = None
         self._pending_digests: List[Tuple[int, Any]] = []
+        # MoE router observability: lazily-built stats program (same
+        # rebuild-on-new-train rule as the digest) and (step, device
+        # vector) pairs fetched + shipped on the report cadence.
+        self._moe_stats_fn = None
+        self._moe_stats_train = None
+        self._pending_moe_stats: List[Tuple[int, Any]] = []
         self._on_step: Optional[Callable[[int, Dict], None]] = None
         self._fit_max_steps = 0
         # Restart-fast compile, layer 1: persistent XLA cache so a restarted
@@ -261,10 +267,16 @@ class ElasticTrainer:
         # The virtual mesh: logical shape fixed at the reference world for
         # the life of the job, folded onto however many members are live.
         # grad_accum is the fold realized in time; the logical shape is
-        # the resize-invariant bit of the compile-cache key.
+        # the resize-invariant bit of the compile-cache key.  The expert
+        # plane (PR 19) is booked at the mesh's expert-axis size: expert
+        # shards fold with the same s % P rule, and the logical expert
+        # world rides train_cache_key via logical_shape.
+        self._expert_world = self._mesh_expert_size()
         self.vmesh = virtual_mesh.VirtualMesh(
             self.mesh, logical_world=self._ref_world,
             physical_world=self._world,
+            expert_logical=self._expert_world,
+            expert_physical=self._expert_world,
         )
         # Live-resize plumbing: the prefetcher handle (for the drain) and
         # the fit loop's loader (for the sampler rebind).
@@ -360,6 +372,14 @@ class ElasticTrainer:
                 self._adopt_checkpoint_accum(self._ckpt.last_extra)
 
     # -- microbatch engine -----------------------------------------------------
+
+    def _mesh_expert_size(self) -> int:
+        """The mesh's expert-axis extent (1 when the axis is unit-sized
+        or absent) — the expert plane's physical world."""
+        names = tuple(getattr(self.mesh, "axis_names", ()))
+        if "expert" not in names:
+            return 1
+        return int(self.mesh.devices.shape[names.index("expert")])
 
     def _dp_shards(self) -> int:
         """How many ways the batch dim splits on this mesh + rule table."""
@@ -500,6 +520,8 @@ class ElasticTrainer:
         self.vmesh = virtual_mesh.VirtualMesh(
             self.mesh, logical_world=self._ref_world,
             physical_world=self._world,
+            expert_logical=self._expert_world,
+            expert_physical=self._expert_world,
         )
         resolved = self._resolve_grad_accum()
         if resolved == self.grad_accum:
@@ -640,6 +662,12 @@ class ElasticTrainer:
             "drained_batches": drained, "rebuilt_program": rebuilt,
             "shard_moves": moves, "sampler_rebound": rebound,
             "embed_moved_rows": embed_moved,
+            # Expert-plane booking: the per-process expert axis is
+            # constant across a data-world resize, so the expert fold is
+            # carried for the master's ledger (relayout_state above moved
+            # the expert-sharded leaves bitwise along with the rest).
+            "expert_world": vmesh.expert_physical,
+            "expert_fold": vmesh.expert_fold,
         }
 
     def _relayout_fallback(
@@ -786,7 +814,36 @@ class ElasticTrainer:
             # Booked inside the step span: the digest dispatch is part
             # of the step's host-observed cost at its check cadence.
             self._sdc_check()
+        if (
+            getattr(self.model_config, "num_experts", 0)
+            and self.step % self.config.report_every == 0
+        ):
+            self._moe_stats_check(placed)
         return metrics
+
+    def _moe_stats_check(self, placed):
+        """Dispatch the router-stats harvest (entropy / load /
+        capacity-drop) on the report cadence; the fetch + telemetry ship
+        ride ``_report``, off the step's critical path.  Best-effort: a
+        model the harvest cannot re-apply (exotic remat policies) logs
+        once and disables itself rather than costing the step loop."""
+        if self._moe_stats_fn is False:
+            return
+        try:
+            if (
+                self._moe_stats_fn is None
+                or self._moe_stats_train is not self.train
+            ):
+                self._moe_stats_fn = train_lib.build_moe_stats_fn(
+                    self.model, self.train
+                )
+                self._moe_stats_train = self.train
+            self._pending_moe_stats.append(
+                (self.step, self._moe_stats_fn(self.state, placed))
+            )
+        except Exception as e:  # noqa: BLE001 — observability must not kill
+            logger.warning("moe stats harvest failed (disabled): %s", e)
+            self._moe_stats_fn = False
 
     # -- device-time capture ---------------------------------------------------
 
@@ -1162,6 +1219,29 @@ class ElasticTrainer:
             # drain RPC.  Off path (memory_report=False) this branch is
             # the one attribute read.
             self._emit_memory_event(step)
+        if self._pending_moe_stats:
+            # Router-health fetch rides the report cadence (queued before
+            # the ring ships below).  Vector layout: [entropy,
+            # drop_fraction, load_0..load_{E-1}] (models/moe.py sow).
+            pending, self._pending_moe_stats = self._pending_moe_stats, []
+            with pipeline_counters().host_block(
+                "moe_stats", steps=tuple(s for s, _ in pending)
+            ):
+                pending = [
+                    (s, np.asarray(jax.device_get(v), np.float64))
+                    for s, v in pending
+                ]
+            for mstep, vec in pending:
+                telemetry.event(
+                    "moe", step=mstep,
+                    entropy=float(vec[0]),
+                    drop_fraction=float(vec[1]),
+                    experts=int(vec.size - 2),
+                    top_k=int(getattr(self.model_config, "top_k", 0)),
+                    load=json.dumps(
+                        [round(float(v), 6) for v in vec[2:]]
+                    ),
+                )
         if self.client is not None:
             self.client.report_step(
                 step,

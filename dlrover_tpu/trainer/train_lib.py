@@ -1211,3 +1211,33 @@ def shard_batch(
 
         pipeline_counters().record_place(time.perf_counter() - t0)
     return out
+
+
+def build_moe_stats_fn(model, train: ShardedTrain):
+    """Router-observability harvest: ``fn(state, placed_batch) -> [2+E]``.
+
+    Re-applies the model forward with ``mutable=["intermediates"]`` so
+    every MoE layer's sown ``moe_stats`` vector ([gate entropy,
+    capacity-drop fraction, per-expert load]) materializes, then averages
+    over layers (and any scan/sow stacking).  A SEPARATE jitted program
+    from the train step — the step never carries the mutable collection,
+    so its trace (and the zero-retrace contract) is untouched; the
+    trainer runs this on the report cadence only, like the SDC digest.
+    """
+
+    @jax.jit
+    def stats(params, tokens):
+        _, inter = model.apply(
+            {"params": params}, tokens, mutable=["intermediates"]
+        )
+        leaves = jax.tree_util.tree_leaves(inter)
+        stacked = jnp.concatenate(
+            [leaf.reshape(-1, leaf.shape[-1]) for leaf in leaves], axis=0
+        )
+        return jnp.mean(stacked, axis=0)
+
+    def run(state, batch):
+        with use_mesh(train.mesh):
+            return stats(state.params, batch["inputs"])
+
+    return run

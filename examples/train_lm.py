@@ -105,6 +105,23 @@ def parse_args():
     p.add_argument("--flash-block-kv", type=int, default=0,
                    help="flash attention key/value block size (0 = model "
                         "default)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="mixture-of-experts: replace each block's MLP with "
+                        "N routed experts (0 = dense). Expert params shard "
+                        "over the mesh's 'expert' axis when one is present")
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="experts each token is routed to")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="per-expert slot budget as a multiple of the "
+                        "balanced load (capacity = cf*S*k/E per batch row; "
+                        "overflow tokens are dropped)")
+    p.add_argument("--moe-dispatch", default="einsum",
+                   choices=("einsum", "a2a", "a2a_int8", "grouped"),
+                   help="expert dispatch transport: einsum (GSPMD one-hot "
+                        "matmuls), a2a (explicit all-to-all exchange over "
+                        "the expert axis), a2a_int8 (same wire, "
+                        "block-quantized int8 payload), grouped (per-device "
+                        "Pallas grouped GEMM; expert axis must be 1)")
     p.add_argument("--sdc-check-every", type=int, default=0,
                    help="silent-data-corruption sentry: every N steps, "
                         "digest the post-update train state on device and "
@@ -171,6 +188,13 @@ def main():
         model_kw["flash_block_q"] = args.flash_block_q
     if args.flash_block_kv:
         model_kw["flash_block_kv"] = args.flash_block_kv
+    if args.moe_experts:
+        model_kw.update(
+            num_experts=args.moe_experts,
+            top_k=args.moe_top_k,
+            capacity_factor=args.moe_capacity_factor,
+            moe_dispatch=args.moe_dispatch,
+        )
     cfg = gpt2_config("124m", **model_kw)
     trainer = ElasticTrainer(
         cfg,

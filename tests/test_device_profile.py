@@ -592,6 +592,8 @@ def _loader(batches, batch, seq, vocab=256, seed=0):
         yield {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
 
 
+@pytest.mark.slow  # real profiled train run + trace parse, ~12s; the
+# parse/emit contract keeps its tier-1 witnesses on fixture traces.
 def test_profiled_cpu_run_books_measured_rows(tmp_path):
     from dlrover_tpu.models.gpt2 import gpt2_config
     from dlrover_tpu.trainer.elastic_trainer import (

@@ -77,6 +77,9 @@ def test_grad_accum_parity_fp32():
     )
 
 
+@pytest.mark.slow  # second accumulator-dtype build, ~16s; the bf16
+# accumulator is certified to the byte by the MEMORY.json temp-bytes
+# gate, and fp32 parity above stays in tier-1.
 def test_grad_accum_bf16_accumulator_tolerance():
     """bf16 accumulation halves accumulator HBM at the price of ~8 bits of
     mantissa per add: loss is microbatch-exact (computed in fp32 before
@@ -208,6 +211,9 @@ def test_cache_key_includes_accum_knobs():
     assert len({k1, k2, k3, k4}) == 4
 
 
+@pytest.mark.slow  # full save/resize/restore drill, ~11s; the resize
+# invariance plane keeps its tier-1 witnesses in test_resize
+# (cross_world_restore_matrix, preempt_resume trajectory).
 def test_elastic_trainer_resize_invariance(tmp_path, monkeypatch):
     """A 'resize' (reference world 16 -> actual world 8) rescales
     grad_accum so tokens/step is invariant, and the booked reference in

@@ -38,6 +38,9 @@ def test_gta_threshold_drops_weak_consensus():
     np.testing.assert_allclose(out["w"], [0.0])
 
 
+@pytest.mark.slow  # 0.2s in isolation but measured a ~110s in-suite
+# stall at this position on the CI box; the outer loop keeps three
+# tier-1 witnesses below (momentum, threshold math, e2e train).
 def test_outer_loop_syncs_on_schedule_with_momentum():
     fabric = {}
 

@@ -1,9 +1,10 @@
 """MoE gating unit tests (dlrover_tpu/models/moe.py)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dlrover_tpu.models.moe import top_k_gating
+from dlrover_tpu.models.moe import MoEMlp, _router_entropy, top_k_gating
 
 
 def test_top2_no_slot_collision():
@@ -36,3 +37,61 @@ def test_combine_weights_normalized():
     token_mass = np.asarray(combine.sum(axis=(2, 3)))
     assert token_mass.max() <= 1.0 + 1e-5
     assert float(aux) > 0.0
+
+
+def test_router_entropy_bounds():
+    """Uniform logits hit ln(E); a collapsed router hits ~0."""
+    e = 8
+    uniform = jnp.zeros((2, 16, e))
+    assert float(_router_entropy(uniform)) == np.log(e).astype(np.float32)
+    collapsed = jnp.zeros((2, 16, e)).at[..., 0].set(100.0)
+    assert float(_router_entropy(collapsed)) < 1e-3
+
+
+def _stats_layer(dispatch, capacity_factor=2.0):
+    return MoEMlp(
+        num_experts=4, d_ff=32, top_k=2, capacity_factor=capacity_factor,
+        activation="gelu", dtype=jnp.float32, param_dtype=jnp.float32,
+        dispatch=dispatch, gmm_block_rows=8,
+    )
+
+
+def test_router_stats_sown_as_intermediates():
+    """Every dispatch path sows the ``moe_stats`` vector — entropy, drop
+    fraction, per-expert load — but only when the caller asks for the
+    intermediates collection (the compiled step never pays for it)."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16)), jnp.float32)
+    layer = _stats_layer("einsum")
+    params = layer.init(jax.random.PRNGKey(3), x)
+    (out, aux), inter = layer.apply(
+        params, x, mutable=["intermediates"]
+    )
+    (vec,) = jax.tree_util.tree_leaves(inter)
+    vec = np.asarray(vec, np.float64).ravel()
+    assert vec.shape == (2 + 4,)
+    entropy, drop, load = vec[0], vec[1], vec[2:]
+    assert 0.0 <= entropy <= np.log(4) + 1e-6
+    assert 0.0 <= drop <= 1.0
+    np.testing.assert_allclose(load.sum(), 1.0, atol=1e-6)
+    # The plain apply returns no intermediates: sow was a no-op.
+    plain = layer.apply(params, x)
+    assert isinstance(plain, tuple) and len(plain) == 2
+
+
+def test_router_stats_grouped_is_dropless():
+    """The grouped path books drop_fraction == 0 (dropless by design)
+    even at a capacity factor that would drop most einsum dispatches."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16)), jnp.float32)
+    layer = _stats_layer("grouped", capacity_factor=0.25)
+    params = layer.init(jax.random.PRNGKey(4), x)
+    _, inter = layer.apply(params, x, mutable=["intermediates"])
+    (vec,) = jax.tree_util.tree_leaves(inter)
+    vec = np.asarray(vec, np.float64).ravel()
+    assert vec[1] == 0.0  # dropless: nothing hit a capacity wall
+
+    einsum_layer = _stats_layer("einsum", capacity_factor=0.25)
+    _, inter = einsum_layer.apply(params, x, mutable=["intermediates"])
+    (evec,) = jax.tree_util.tree_leaves(inter)
+    assert float(np.ravel(evec)[1]) > 0.0  # the einsum path DID drop

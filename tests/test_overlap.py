@@ -186,6 +186,9 @@ def test_overlap_matches_serialized(data, fsdp, grad_accum):
     )
 
 
+@pytest.mark.slow  # second full overlap build, ~25s; the int8 wire is
+# graded directly in test_quantized_collectives, the overlap schedule by
+# test_overlap_matches_serialized above.
 def test_overlap_int8_transports_match_within_quant_tolerance():
     """int8 reduce-scatter per microbatch + int8 re-replication
     all-gather: one quantization round per leg, so the bound scales with

@@ -116,6 +116,8 @@ def test_zero_temperature_is_greedy_argmax():
         np.testing.assert_array_equal(np.asarray(out), [1, 0])
 
 
+@pytest.mark.slow  # compiles a 2-stage pipeline build just to prove
+# the raise, ~15s on 1 core
 def test_kv_cache_requires_single_pipeline_stage():
     """use_kv_cache=True builds a decode backend whose params mirror a
     pipeline_stages=1 layer scan; a pipelined model config would feed it

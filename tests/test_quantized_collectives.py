@@ -12,8 +12,10 @@ from jax.sharding import PartitionSpec as P
 from dlrover_tpu.parallel.quantized_collectives import (
     _block_dequant,
     _block_quant,
+    a2a_wire_bytes,
     quantized_all_gather,
     quantized_all_reduce,
+    quantized_all_to_all,
 )
 from dlrover_tpu.runtime.mesh import (
     ParallelConfig,
@@ -211,6 +213,147 @@ def test_quantized_all_gather_nonzero_dim():
     assert got.shape == (4, 3, 320)
     want = np.concatenate(list(np.asarray(x)), axis=1)
     np.testing.assert_allclose(got[0], want, atol=0.05, rtol=0.05)
+
+
+def _run_a2a(x, *, split_axis=0, concat_axis=0, block=256, quant=True):
+    """Drive an all-to-all over the data axis; each member contributes
+    its leading block and the per-member results come back stacked."""
+    mesh = build_mesh(ParallelConfig(data=4, fsdp=2))
+    specs = P("data", *([None] * (x.ndim - 1)))
+
+    @functools.partial(
+        shard_map_compat, mesh=mesh, in_specs=specs, out_specs=specs,
+    )
+    def exchange(shard):
+        if quant:
+            out = quantized_all_to_all(
+                shard[0], "data", split_axis=split_axis,
+                concat_axis=concat_axis, block=block,
+            )
+        else:
+            out = jax.lax.all_to_all(
+                shard[0], "data", split_axis, concat_axis, tiled=True
+            )
+        return out[None]
+
+    return exchange(x)
+
+
+def test_quantized_all_to_all_matches_fp32_reference():
+    """Chunk routing is bit-for-bit the tiled all_to_all's (same member
+    order, same concat placement); values land within the per-block int8
+    bound of the exact fp32 exchange."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    rng = np.random.default_rng(29)
+    x = jnp.asarray(rng.normal(size=(4, 8, 64)), jnp.float32)
+    got = np.asarray(_run_a2a(x))
+    want = np.asarray(_run_a2a(x, quant=False))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=0.05, rtol=0.05)
+
+
+def test_quantized_all_to_all_partial_blocks():
+    """Chunks whose flat size is not a multiple of the quant block pad at
+    the source and slice after dequant — no wraparound garbage."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    rng = np.random.default_rng(31)
+    # per-member chunk is 70*3 = 210 elements: 210 % 256 != 0
+    x = jnp.asarray(rng.normal(size=(4, 280, 3)), jnp.float32)
+    got = np.asarray(_run_a2a(x))
+    want = np.asarray(_run_a2a(x, quant=False))
+    np.testing.assert_allclose(got, want, atol=0.05, rtol=0.05)
+
+
+def test_quantized_all_to_all_preserves_bf16():
+    """bf16 dispatch activations come back bf16 with the exchanged
+    shape — the MoE dispatch caller feeds whatever dtype the layer
+    computes in."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    rng = np.random.default_rng(37)
+    x = jnp.asarray(rng.normal(size=(4, 8, 40)), jnp.bfloat16)
+    got = _run_a2a(x)
+    assert got.dtype == jnp.bfloat16
+    assert got.shape == x.shape
+    want = np.asarray(
+        _run_a2a(x.astype(jnp.float32), quant=False)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, atol=0.08, rtol=0.08,
+    )
+
+
+def test_quantized_all_to_all_split_concat_axes():
+    """split/concat on distinct nonzero axes reshapes exactly like the
+    tiled reference (split dim shrinks by n, concat dim grows by n)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    rng = np.random.default_rng(41)
+    x = jnp.asarray(rng.normal(size=(4, 8, 12)), jnp.float32)
+    got = np.asarray(_run_a2a(x, split_axis=1, concat_axis=0))
+    want = np.asarray(_run_a2a(x, split_axis=1, concat_axis=0, quant=False))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=0.05, rtol=0.05)
+
+
+def test_quantized_all_to_all_involution_roundtrip():
+    """With split_axis == concat_axis a second exchange routes every
+    chunk home — the MoE dispatch-out/combine-back pair.  Two a2a legs =
+    two quantization rounds of error, nothing more."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = build_mesh(ParallelConfig(data=4, fsdp=2))
+    rng = np.random.default_rng(43)
+    x = jnp.asarray(rng.normal(size=(4, 8, 16)), jnp.float32)
+
+    @functools.partial(
+        shard_map_compat, mesh=mesh,
+        in_specs=P("data", None, None), out_specs=P("data", None, None),
+    )
+    def roundtrip(shard):
+        mid = quantized_all_to_all(shard[0], "data", block=64)
+        return quantized_all_to_all(mid, "data", block=64)[None]
+
+    got = np.asarray(roundtrip(x))
+    np.testing.assert_allclose(got, np.asarray(x), atol=0.1, rtol=0.1)
+
+
+def test_quantized_all_to_all_single_member_is_identity():
+    """Axis size 1: no wire, no quantization — bit-exact passthrough."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = build_mesh(ParallelConfig(data=1, fsdp=len(jax.devices())))
+    x = jnp.arange(24.0).reshape(4, 6)
+
+    @functools.partial(
+        shard_map_compat, mesh=mesh, in_specs=P(), out_specs=P(),
+    )
+    def exchange(v):
+        return quantized_all_to_all(v, "data", block=8)
+
+    np.testing.assert_array_equal(np.asarray(exchange(x)), np.asarray(x))
+
+
+def test_quantized_all_to_all_indivisible_split_raises():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    x = jnp.zeros((4, 6, 3))  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="must divide"):
+        _run_a2a(x)
+
+
+def test_a2a_wire_bytes_int8_strictly_cheaper():
+    """The modeled int8 leg undercuts fp32 at every payload size — the
+    pricing invariant the MoE gate certifies."""
+    # (a 1-element leg is the one place the 4 B block scale loses; real
+    # dispatch payloads are token*d_model-sized)
+    for n in (2, 3, 255, 256, 257, 1 << 16):
+        assert a2a_wire_bytes(n, "int8") < a2a_wire_bytes(n, "none")
+    # exact forms: 1 B/elem + 4 B/block vs 4 B/elem
+    assert a2a_wire_bytes(512, "int8", block=256) == 512 + 2 * 4
+    assert a2a_wire_bytes(512, "none") == 2048
 
 
 def test_local_sgd_quantized_transport_single_host():

@@ -474,6 +474,62 @@ def test_virtual_mesh_ownership_and_plan():
     )
 
 
+def test_virtual_mesh_expert_plane():
+    """The expert plane folds with the same ``s % P`` rule as the data
+    plane, independently: ownership, fold factor, axis-tagged relayout
+    entries, and the logical shape scaling the mesh's expert axis."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    from dlrover_tpu.runtime import virtual_mesh
+    from dlrover_tpu.runtime.mesh import ParallelConfig, build_mesh
+
+    mesh = build_mesh(ParallelConfig(data=2, expert=4))
+    vm = virtual_mesh.VirtualMesh(
+        mesh, logical_world=2, physical_world=2,
+        expert_logical=4, expert_physical=4,
+    )
+    # Identity at E_L == E_P; defaults keep pre-MoE constructions intact.
+    assert vm.expert_fold == 1
+    assert [vm.expert_owner(s) for s in range(4)] == [0, 1, 2, 3]
+    legacy = virtual_mesh.VirtualMesh(mesh, logical_world=2,
+                                      physical_world=2)
+    assert legacy.expert_logical == legacy.expert_physical == 1
+
+    folded = vm.with_expert_world(2)
+    assert folded.expert_fold == 2
+    assert folded.owned_expert_shards(0) == (0, 2)
+    assert folded.owned_expert_shards(1) == (1, 3)
+    assert folded.owned_expert_shards(2) == ()
+    # The data fold is untouched by an expert re-fold, and vice versa.
+    assert folded.fold == vm.fold == 1
+
+    # Expert moves are axis-tagged; data entries keep their legacy shape.
+    plan = vm.relayout_plan(2, new_expert_world=2)
+    assert plan == [
+        {"axis": "expert", "shard": 2, "src": 2, "dst": 0},
+        {"axis": "expert", "shard": 3, "src": 3, "dst": 1},
+    ]
+    mixed = vm.relayout_plan(1, new_expert_world=2)
+    data_moves = [m for m in mixed if "axis" not in m]
+    expert_moves = [m for m in mixed if m.get("axis") == "expert"]
+    assert data_moves == [{"shard": 1, "src": 1, "dst": 0}]
+    assert len(expert_moves) == 2
+
+    # logical_shape scales the expert axis by the logical expert world —
+    # and is invariant across BOTH folds (the compile-key bit).
+    names = tuple(mesh.axis_names)
+    eidx = names.index("expert")
+    assert vm.logical_shape[eidx] == 4 * mesh.devices.shape[eidx]
+    assert vm.logical_shape == folded.logical_shape
+    assert vm.logical_shape == vm.with_world(1).logical_shape
+
+    # Degenerate expert worlds are rejected like data worlds are.
+    with pytest.raises(ValueError):
+        virtual_mesh.VirtualMesh(
+            mesh, logical_world=2, physical_world=2, expert_logical=0,
+        )
+
+
 def _lm_model():
     from dlrover_tpu.models.gpt2 import gpt2_config
 

@@ -321,6 +321,78 @@ def test_embed_ledger_newest_wins_max_aggregation_and_state():
     assert fresh.embed_ledger() == ledger
 
 
+def test_moe_event_routes_through_servicer_into_gauges():
+    """A ``moe`` telemetry event lands in the speed monitor's router
+    ledger, and the ``dlrover_moe_*`` gauges render its snapshot —
+    including the per-expert load as a labeled gauge family."""
+    sm = SpeedMonitor()
+    timeline = JobTimeline()
+    servicer = MasterServicer(speed_monitor=sm, timeline=timeline)
+    attrs = {
+        "step": 40, "entropy": 1.15, "drop_fraction": 0.03,
+        "experts": 4, "top_k": 2,
+        "load": "[0.26, 0.25, 0.25, 0.24]",
+        "unknown_future_attr": 1,  # trainers may grow the event
+    }
+    wire = pickle.dumps(msg.Envelope(
+        node_id=3,
+        payload=msg.TelemetryEvents(
+            3, (("moe", "event", 0.0, 0.0, attrs),)
+        ),
+    ))
+    assert servicer.report(msg.safe_loads(wire)).success
+    ledger = sm.moe_ledger()
+    assert ledger["entropy"] == pytest.approx(1.15)
+    assert ledger["drop_fraction"] == pytest.approx(0.03)
+    assert ledger["experts"] == 4 and ledger["top_k"] == 2
+    assert ledger["load"] == pytest.approx([0.26, 0.25, 0.25, 0.24])
+    text = timeline.render_metrics(speed_monitor=sm)
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            metrics[key] = float(value)
+    assert metrics["dlrover_moe_gate_entropy"] == pytest.approx(1.15)
+    assert metrics["dlrover_moe_capacity_drop_fraction"] == (
+        pytest.approx(0.03)
+    )
+    assert metrics["dlrover_moe_experts"] == 4
+    assert metrics["dlrover_moe_top_k"] == 2
+    assert metrics["dlrover_moe_reporters"] == 1
+    assert metrics['dlrover_moe_expert_load{expert="0"}'] == (
+        pytest.approx(0.26)
+    )
+    assert metrics['dlrover_moe_expert_load{expert="3"}'] == (
+        pytest.approx(0.24)
+    )
+    # The labeled family still carries exactly one HELP/TYPE pair.
+    assert text.count("# HELP dlrover_moe_expert_load") == 1
+    assert text.count("# TYPE dlrover_moe_expert_load gauge") == 1
+
+
+def test_moe_ledger_newest_wins_and_aggregates():
+    """Per-node router snapshots are newest-wins; the aggregate averages
+    entropy/drop/load across reporters and takes the max of the geometry
+    fields (every replica trains the same model)."""
+    sm = SpeedMonitor()
+    sm.record_moe(0, step=10, entropy=1.0, drop_fraction=0.0,
+                  experts=2, top_k=1, load=[0.5, 0.5])
+    sm.record_moe(0, step=20, entropy=0.6, drop_fraction=0.1,
+                  experts=2, top_k=1, load=[0.8, 0.2])  # newest
+    sm.record_moe(1, step=18, entropy=0.4, drop_fraction=0.3,
+                  experts=2, top_k=1, load=[0.6, 0.4])
+    ledger = sm.moe_ledger()
+    assert ledger["moe_events"] == 3 and ledger["reporters"] == 2
+    assert ledger["step"] == 20
+    assert ledger["entropy"] == pytest.approx(0.5)
+    assert ledger["drop_fraction"] == pytest.approx(0.2)
+    assert ledger["load"] == pytest.approx([0.7, 0.3])
+    # A reporter with a stale-width load vector is excluded from the
+    # elementwise mean, never crashes it.
+    sm.record_moe(2, experts=2, top_k=1, load=[1.0])
+    assert sm.moe_ledger()["load"] == pytest.approx([0.7, 0.3])
+
+
 def test_plane_emit_telemetry_books_the_stats_snapshot():
     """``ShardedEmbeddingTable.emit_telemetry`` books one ``embed`` event
     whose attrs are exactly the stats the master's ledger consumes."""

@@ -474,6 +474,101 @@ def test_overlap_json_artifact_certified():
     )
 
 
+def test_moe_bench_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "moe_bench.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--out" in out.stdout and "--experts" in out.stdout
+    assert "--top-k" in out.stdout and "--capacity-factor" in out.stdout
+    assert "--dispatch" in out.stdout and "--resize-steps" in out.stdout
+
+
+def _moe_result():
+    """A MOE.json-shaped dict that passes every gate check — the
+    single-mutation matrix below breaks one leg at a time."""
+    return {
+        "dense": {"tokens_per_s": 2000.0, "retraces": 0},
+        "moe": {"tokens_per_s": 7000.0, "retraces": 0},
+        "wire": {"payload_elems": 20480, "fp32_bytes": 81920,
+                 "int8_bytes": 20800},
+        "resize": {"expert_leaves": 3, "bitwise_equal": True},
+    }
+
+
+def test_moe_bench_gate_predicate():
+    """The MOE.json ok gate is a pure predicate; each certification leg
+    (throughput vs the dense iso-FLOP baseline, int8 wire discount,
+    bitwise resize parity, zero retraces) fails as its own named check."""
+    import copy
+
+    tool = _load_module(
+        os.path.join(REPO, "tools", "moe_bench.py"), "_moe_bench"
+    )
+    ok, failed = tool.evaluate_moe_gate(_moe_result())
+    assert ok and failed == []
+
+    def mutate(fn):
+        result = copy.deepcopy(_moe_result())
+        fn(result)
+        return tool.evaluate_moe_gate(result)
+
+    ok, failed = mutate(lambda r: r["moe"].update(tokens_per_s=1500.0))
+    assert not ok and failed == ["moe_tokens_per_s_beats_dense"]
+
+    ok, failed = mutate(lambda r: r["wire"].update(int8_bytes=90000))
+    assert not ok and failed == ["int8_dispatch_wire_cheaper"]
+
+    ok, failed = mutate(lambda r: r["resize"].update(bitwise_equal=False))
+    assert not ok and failed == ["resize_expert_state_bitwise"]
+
+    # An empty expert-leaf set must fail too: "nothing compared" is not
+    # parity.
+    ok, failed = mutate(lambda r: r["resize"].update(expert_leaves=0))
+    assert not ok and failed == ["resize_expert_state_bitwise"]
+
+    ok, failed = mutate(lambda r: r["moe"].update(retraces=2))
+    assert not ok and failed == ["steady_state_no_retrace"]
+
+
+def test_moe_json_artifact_certified():
+    """The committed MOE.json must be a real certified run: the gate
+    re-evaluates to ok on the booked numbers, the MoE build beat the
+    dense iso-FLOP baseline, and the fold preserved expert state."""
+    path = os.path.join(REPO, "MOE.json")
+    with open(path) as f:
+        result = json.load(f)
+    tool = _load_module(
+        os.path.join(REPO, "tools", "moe_bench.py"), "_moe_bench2"
+    )
+    ok, failed = tool.evaluate_moe_gate(result)
+    assert ok, f"MOE.json fails its own gate: {failed}"
+    assert result["ok"] is True
+    assert result["moe"]["tokens_per_s"] > result["dense"]["tokens_per_s"]
+    assert result["wire"]["int8_bytes"] < result["wire"]["fp32_bytes"]
+    assert result["resize"]["bitwise_equal"] is True
+    assert result["resize"]["expert_leaves"] >= 1
+    assert result["config"]["d_ff_dense"] == (
+        result["config"]["experts"] * result["config"]["d_ff_expert"]
+    )
+
+
+def test_train_lm_moe_flags_help(cpu_child_env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_lm.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--moe-experts" in out.stdout
+    assert "--moe-top-k" in out.stdout
+    assert "--moe-capacity-factor" in out.stdout
+    assert "--moe-dispatch" in out.stdout
+    assert "a2a_int8" in out.stdout
+
+
 def test_train_rec_help(cpu_child_env):
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "train_rec.py"),

@@ -68,6 +68,9 @@ def test_train_step_strategies(parallel):
     assert losses[-1] < losses[0]  # tiny model memorizes quickly
 
 
+@pytest.mark.slow  # cross-compiles every strategy in one test, ~14s;
+# each strategy keeps its own tier-1 witness in
+# test_train_step_strategies.
 def test_strategies_numerically_agree():
     """The same model must produce the same loss under any strategy."""
     losses_dp, _, _ = run_steps(TINY_GPT, ParallelConfig(), n_steps=2)
@@ -85,6 +88,8 @@ def test_llama_variant_runs():
     assert all(np.isfinite(losses))
 
 
+@pytest.mark.slow  # superseded as tier-1 witness by the dedicated
+# test_moe_trainer suite (layer-bitwise parity, compose, sharding).
 def test_moe_expert_parallel():
     cfg = moe_llama_config(
         "tiny", num_experts=4, num_layers=2, max_seq_len=64, vocab_size=256

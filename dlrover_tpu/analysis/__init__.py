@@ -19,10 +19,17 @@ Entry points:
 from dlrover_tpu.analysis.core import (  # noqa: F401  (public API re-export)
     Finding,
     FileContext,
+    ProjectRule,
     Rule,
     all_rules,
     get_rule,
     register,
+)
+from dlrover_tpu.analysis.project import (  # noqa: F401
+    ModuleInfo,
+    ProjectContext,
+    load_project,
+    module_name_for,
 )
 from dlrover_tpu.analysis.engine import (  # noqa: F401
     Report,

@@ -141,6 +141,39 @@ class Rule:
             )
 
 
+class ProjectRule(Rule):
+    """A rule over the whole analyzed tree at once (tracelint v3).
+
+    Where :class:`Rule` sees one :class:`FileContext`, a ProjectRule's
+    ``check_project`` receives the linked
+    :class:`~dlrover_tpu.analysis.project.ProjectContext` — symbol
+    tables, import resolution and the cross-module call graph — and may
+    yield findings against any analyzed file.  The engine applies the
+    same suppression/baseline filtering by mapping each finding's path
+    back to its file, and the same crash isolation: a crashing project
+    rule becomes one visible finding, never a dead gate.
+    """
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        return iter(())  # project rules do not run per-file
+
+    def check_project(self, project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def run_project(self, project) -> Iterator[Finding]:
+        try:
+            yield from self.check_project(project)
+        except Exception as e:  # noqa: BLE001 - isolation boundary
+            yield Finding(
+                rule=self.id,
+                path=project.anchor_path,
+                line=1,
+                col=1,
+                message=f"rule crashed: {type(e).__name__}: {e}",
+                symbol="__rule_crash__",
+            )
+
+
 _REGISTRY: Dict[str, Rule] = {}
 
 

@@ -87,13 +87,13 @@ def stmt_defs(stmt: ast.stmt) -> Set[str]:
             out.add(stmt.name)
         return out  # body statements are their own CFG nodes
     # Walrus targets nested in the statement's own expressions.
-    for node in _own_expr_nodes(stmt):
+    for node in own_expr_nodes(stmt):
         if isinstance(node, ast.NamedExpr):
             out.update(_target_names(node.target))
     return out
 
 
-def _own_expr_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+def own_expr_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
     """Expression nodes evaluated *by this statement itself* — compound
     statements contribute only their header expressions (an ``if``'s
     test, a ``for``'s iter), never their bodies, which are separate CFG
@@ -128,7 +128,7 @@ def stmt_uses(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
     """``(binding, node)`` for every read of a tracked binding performed
     by ``stmt`` itself (headers only for compound statements)."""
     out: List[Tuple[str, ast.AST]] = []
-    for node in _own_expr_nodes(stmt):
+    for node in own_expr_nodes(stmt):
         if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
             out.append((node.id, node))
         elif isinstance(node, ast.Attribute) and isinstance(

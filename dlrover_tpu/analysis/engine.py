@@ -20,6 +20,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 from dlrover_tpu.analysis.core import (
     FileContext,
     Finding,
+    ProjectRule,
     Rule,
     select_rules,
 )
@@ -212,17 +213,36 @@ def run_paths(
     select: Optional[Sequence[str]] = None,
     baseline: Optional[Dict[str, str]] = None,
     root: Optional[str] = None,
+    only_files: Optional[Sequence[str]] = None,
 ) -> Report:
     """Analyze every ``.py`` under ``paths`` with the selected rules.
 
     ``root`` anchors the repo-relative paths findings (and baselines) use;
     it defaults to the common parent of ``paths``' absolute forms' CWD —
     in practice, pass the repo root.
+
+    ``only_files`` (repo-relative posix paths) restricts which files the
+    *per-file* rules run on — the ``--changed`` incremental mode.  Every
+    file is still parsed, and project-scope rules always see (and may
+    report against) the whole tree: a cross-module contract has no
+    meaningful per-file restriction.
     """
     rules: List[Rule] = select_rules(select)
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
     baseline = baseline or {}
     root = os.path.abspath(root or os.getcwd())
     report = Report(findings=[], rules_run=len(rules))
+
+    def book(ctx: Optional[FileContext], finding: Finding):
+        if ctx is not None and ctx.is_suppressed(finding):
+            report.suppressed += 1
+        elif finding.baseline_key in baseline:
+            report.baselined += 1
+        else:
+            report.findings.append(finding)
+
+    contexts: List[FileContext] = []
     for file_path in iter_python_files(paths):
         rel = os.path.relpath(os.path.abspath(file_path), root)
         rel = rel.replace(os.sep, "/")
@@ -244,14 +264,27 @@ def run_paths(
                 message=f"syntax error: {e.msg}", symbol="__syntax__",
             ))
             continue
-        ctx = FileContext(rel, source, tree)
-        for rule in rules:
+        contexts.append(FileContext(rel, source, tree))
+
+    lint_set = (
+        None if only_files is None
+        else {p.replace(os.sep, "/") for p in only_files}
+    )
+    for ctx in contexts:
+        if lint_set is not None and ctx.rel_path not in lint_set:
+            continue
+        for rule in file_rules:
             for finding in rule.run(ctx):
-                if ctx.is_suppressed(finding):
-                    report.suppressed += 1
-                elif finding.baseline_key in baseline:
-                    report.baselined += 1
-                else:
-                    report.findings.append(finding)
+                book(ctx, finding)
+
+    if project_rules and contexts:
+        from dlrover_tpu.analysis.project import ProjectContext
+
+        project = ProjectContext(contexts)
+        by_path = {ctx.rel_path: ctx for ctx in contexts}
+        for rule in project_rules:
+            for finding in rule.run_project(project):
+                book(by_path.get(finding.path), finding)
+
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return report

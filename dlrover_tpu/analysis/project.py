@@ -1,0 +1,421 @@
+"""Whole-repo interprocedural context: the tracelint v3 engine layer.
+
+``jaxast`` and ``dataflow`` see one module at a time; the contracts the
+v3 rules check (cache-key coverage, telemetry routing, lock discipline)
+span modules — ``build_sharded_train`` lives three imports away from
+``train_cache_key``.  :class:`ProjectContext` closes that gap with a
+two-phase build:
+
+1. **Symbol phase** — every parsed file becomes a :class:`ModuleInfo`:
+   its dotted module name (derived from the repo-relative path), its
+   top-level symbols, every function/class with a stable qualname, and
+   an import table mapping each local alias to an *absolute* dotted
+   target (``import x as y``, ``from m import n as a``, and relative
+   imports all normalized).
+2. **Link phase** — name resolution (:meth:`ProjectContext.resolve`)
+   follows aliases and one-hop re-exports (``__init__`` style ``from .m
+   import f``) with a cycle guard, and the cross-module call graph keys
+   callers and callees by ``(module, qualname)``.
+
+Everything stays pure-stdlib ``ast`` and deterministic: iteration orders
+are sorted so ``--write-baseline`` stays byte-stable across runs.  Like
+the intra-module layers, resolution is approximate in the direction of a
+linter — dynamic dispatch, star imports and attribute reassignment are
+out of scope, and unresolved names resolve to ``None`` rather than
+guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext
+
+#: A project-scope function key: (dotted module name, qualname).
+FuncKey = Tuple[str, str]
+
+
+def module_name_for(rel_path: str) -> str:
+    """Dotted module name for a repo-relative posix path:
+    ``dlrover_tpu/trainer/train_lib.py`` -> ``dlrover_tpu.trainer.train_lib``;
+    a package ``__init__.py`` names the package itself."""
+    path = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    parts = [p for p in path.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class ModuleInfo:
+    """Phase-1 product for one parsed file: symbols, imports, defs."""
+
+    def __init__(self, module: str, ctx: FileContext):
+        self.module = module
+        self.ctx = ctx
+        #: local alias -> absolute dotted target (module or module.symbol).
+        self.imports: Dict[str, str] = {}
+        #: dotted module names this file imports (for the import graph).
+        self.imported_modules: Set[str] = set()
+        #: top-level name -> defining statement (def/class/assign).
+        self.symbols: Dict[str, ast.AST] = {}
+        #: top-level name -> assigned value expression (module constants —
+        #: how TEL001 reads a routing table's dict literal).
+        self.constants: Dict[str, ast.expr] = {}
+        #: qualname -> def node, methods and nested defs included.
+        self.functions: Dict[str, jaxast.FunctionNode] = {}
+        #: qualname -> class def (nested classes use dotted qualnames).
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self._collect()
+
+    # -- phase 1: symbols + imports ---------------------------------------
+
+    def _package(self) -> str:
+        """The package a relative import resolves against."""
+        if self.ctx.rel_path.endswith("__init__.py"):
+            return self.module
+        return self.module.rpartition(".")[0]
+
+    def _collect(self):
+        tree = self.ctx.tree
+        for qual, node in jaxast.iter_functions(tree):
+            self.functions[qual] = node
+        self._collect_classes(tree, "")
+        for stmt in tree.body:
+            if isinstance(
+                stmt, jaxast.FUNCTION_NODES + (ast.ClassDef,)
+            ):
+                self.symbols[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self.symbols[target.id] = stmt
+                        self.constants[target.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign):
+                if isinstance(stmt.target, ast.Name):
+                    self.symbols[stmt.target.id] = stmt
+                    if stmt.value is not None:
+                        self.constants[stmt.target.id] = stmt.value
+        # Imports anywhere in the file (function-local ones included —
+        # they alias the same targets; last one wins, a linter-grade
+        # approximation).
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.imports[alias.asname] = alias.name
+                    else:
+                        # ``import a.b.c`` binds ``a`` locally.
+                        head = alias.name.split(".")[0]
+                        self.imports.setdefault(head, head)
+                    self.imported_modules.add(alias.name)
+                    self.symbols.setdefault(
+                        alias.asname or alias.name.split(".")[0], node
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg_parts = self._package().split(".")
+                    if node.level - 1 > 0:
+                        pkg_parts = pkg_parts[: -(node.level - 1)] or []
+                    pkg = ".".join(p for p in pkg_parts if p)
+                    base = f"{pkg}.{base}" if base else pkg
+                if not base:
+                    continue
+                self.imported_modules.add(base)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{base}.{alias.name}"
+                    self.symbols.setdefault(local, node)
+
+    def _collect_classes(self, node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                qual = f"{prefix}{child.name}"
+                self.classes[qual] = child
+                self._collect_classes(child, qual + ".")
+            else:
+                self._collect_classes(child, prefix)
+
+    def class_of(self, qualname: str) -> str:
+        """Qualname of the class ``qualname`` is a method of ("" when
+        it is not a method)."""
+        owner = qualname.rpartition(".")[0]
+        return owner if owner in self.classes else ""
+
+
+class ProjectContext:
+    """Phase-2 product: every module linked by imports and calls."""
+
+    def __init__(self, contexts: Iterable[FileContext]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        for ctx in sorted(contexts, key=lambda c: c.rel_path):
+            info = ModuleInfo(module_name_for(ctx.rel_path), ctx)
+            # First spelling wins on a module-name collision (two roots
+            # shipping an ``x.py``) — deterministic either way.
+            self.modules.setdefault(info.module, info)
+            self.by_path[ctx.rel_path] = info
+        self._call_graph: Optional[Dict[FuncKey, Set[FuncKey]]] = None
+        self._callers: Optional[Dict[FuncKey, Set[FuncKey]]] = None
+
+    @property
+    def anchor_path(self) -> str:
+        """Stable path for project-scope findings with no single file."""
+        return min(self.by_path) if self.by_path else "<project>"
+
+    # -- name resolution ----------------------------------------------------
+
+    def resolve(
+        self, module: str, dotted: str
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        """Resolve ``dotted`` as written inside ``module`` to its defining
+        ``(ModuleInfo, symbol-qualname)``.  Follows import aliases and
+        re-exports; returns ``(info, "")`` when ``dotted`` names a module
+        itself, ``None`` when the name leaves the analyzed tree."""
+        info = self.modules.get(module)
+        if info is None or not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head in info.imports:
+            target = info.imports[head]
+            return self.resolve_absolute(
+                f"{target}.{rest}" if rest else target
+            )
+        if head in info.symbols:
+            return self._local_symbol(info, dotted)
+        return None
+
+    def resolve_absolute(
+        self, dotted: str, _seen: Optional[Set[str]] = None
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        """Resolve an absolute dotted name (``pkg.mod.Class.method``)."""
+        _seen = set() if _seen is None else _seen
+        if dotted in _seen:
+            return None  # import cycle / self-referential re-export
+        _seen.add(dotted)
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = ".".join(parts[:i])
+            info = self.modules.get(mod)
+            if info is None:
+                continue
+            rest = ".".join(parts[i:])
+            if not rest:
+                return (info, "")
+            head = parts[i]
+            if head in info.imports and head not in (
+                set(info.functions) | set(info.classes)
+            ):
+                # Re-export: ``__init__`` doing ``from .m import f``.
+                target = info.imports[head]
+                tail = ".".join(parts[i + 1:])
+                return self.resolve_absolute(
+                    f"{target}.{tail}" if tail else target, _seen
+                )
+            if head in info.symbols:
+                return self._local_symbol(info, rest)
+            return None
+        return None
+
+    @staticmethod
+    def _local_symbol(
+        info: ModuleInfo, qual: str
+    ) -> Tuple[ModuleInfo, str]:
+        return (info, qual)
+
+    # -- import graph -------------------------------------------------------
+
+    def imported_module_infos(self, info: ModuleInfo) -> Set[str]:
+        """Analyzed modules ``info`` imports (targets mapped to their
+        longest in-tree module prefix)."""
+        out: Set[str] = set()
+        targets = set(info.imported_modules) | set(info.imports.values())
+        for target in targets:
+            parts = target.split(".")
+            for i in range(len(parts), 0, -1):
+                mod = ".".join(parts[:i])
+                if mod in self.modules and mod != info.module:
+                    out.add(mod)
+                    break
+        return out
+
+    def reverse_import_closure(
+        self, rel_paths: Iterable[str]
+    ) -> Set[str]:
+        """``rel_paths`` plus every analyzed file that (transitively)
+        imports one of them — the files whose lint verdict a change to
+        ``rel_paths`` can alter.  Unknown paths pass through unchanged."""
+        importers: Dict[str, Set[str]] = {}
+        for info in self.modules.values():
+            for dep in self.imported_module_infos(info):
+                importers.setdefault(dep, set()).add(info.module)
+        out: Set[str] = set()
+        work: List[str] = []
+        for rel in rel_paths:
+            out.add(rel)
+            info = self.by_path.get(rel)
+            if info is not None:
+                work.append(info.module)
+        seen: Set[str] = set(work)
+        while work:
+            mod = work.pop()
+            out.add(self.modules[mod].ctx.rel_path)
+            for up in importers.get(mod, ()):
+                if up not in seen:
+                    seen.add(up)
+                    work.append(up)
+        return out
+
+    # -- call graph ---------------------------------------------------------
+
+    def call_graph(self) -> Dict[FuncKey, Set[FuncKey]]:
+        """``(module, qualname) -> callee keys`` over every analyzed
+        function.  Edges: bare/dotted calls through the import table,
+        ``self.m()`` within a class, and ``ClassName()`` construction
+        (edged to ``Class.__init__`` when defined, the class otherwise)."""
+        if self._call_graph is not None:
+            return self._call_graph
+        graph: Dict[FuncKey, Set[FuncKey]] = {}
+        for mod in sorted(self.modules):
+            info = self.modules[mod]
+            for qual in sorted(info.functions):
+                fn = info.functions[qual]
+                key = (mod, qual)
+                edges = graph.setdefault(key, set())
+                for node in jaxast.body_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self.resolve_call(info, qual, node)
+                    if callee is not None:
+                        edges.add(callee)
+        self._call_graph = graph
+        return graph
+
+    def resolve_call(
+        self, info: ModuleInfo, caller_qual: str, call: ast.Call
+    ) -> Optional[FuncKey]:
+        """The ``(module, qualname)`` a call resolves to, or ``None``."""
+        name = jaxast.call_name(call)
+        if not name:
+            return None
+        if name.startswith("self."):
+            cls = info.class_of(caller_qual)
+            if cls:
+                method = name[len("self."):].split(".")[0]
+                target = f"{cls}.{method}"
+                if target in info.functions:
+                    return (info.module, target)
+            return None
+        resolved = self.resolve(info.module, name)
+        if resolved is None:
+            return None
+        target_info, sym = resolved
+        if not sym:
+            return None
+        if sym in target_info.classes:
+            init = f"{sym}.__init__"
+            if init in target_info.functions:
+                return (target_info.module, init)
+            return (target_info.module, sym)
+        if sym in target_info.functions:
+            return (target_info.module, sym)
+        return None
+
+    def _caller_graph(self) -> Dict[FuncKey, Set[FuncKey]]:
+        if self._callers is None:
+            callers: Dict[FuncKey, Set[FuncKey]] = {}
+            for src, dsts in self.call_graph().items():
+                for dst in dsts:
+                    callers.setdefault(dst, set()).add(src)
+            self._callers = callers
+        return self._callers
+
+    def callees_closure(
+        self, seeds: Iterable[FuncKey]
+    ) -> Set[FuncKey]:
+        return self._closure(seeds, self.call_graph())
+
+    def callers_closure(
+        self, seeds: Iterable[FuncKey]
+    ) -> Set[FuncKey]:
+        return self._closure(seeds, self._caller_graph())
+
+    @staticmethod
+    def _closure(
+        seeds: Iterable[FuncKey], graph: Dict[FuncKey, Set[FuncKey]]
+    ) -> Set[FuncKey]:
+        out: Set[FuncKey] = set(seeds)
+        work = list(out)
+        while work:
+            key = work.pop()
+            for nxt in graph.get(key, ()):
+                if nxt not in out:
+                    out.add(nxt)
+                    work.append(nxt)
+        return out
+
+    # -- trace-entry closure (jaxast lifted to package scope) ---------------
+
+    def trace_entry_closure(self) -> Set[FuncKey]:
+        """Every function that can run under a JAX trace, project-wide:
+        jaxast's per-module seeds (decorators + entry-call arguments)
+        closed over the cross-module call graph instead of only the
+        intra-module one."""
+        seeds: Set[FuncKey] = set()
+        for mod in sorted(self.modules):
+            info = self.modules[mod]
+            bare = jaxast.traced_function_names(info.ctx.tree)
+            for qual in sorted(info.functions):
+                if qual.split(".")[-1] in bare:
+                    seeds.add((mod, qual))
+        return self.callees_closure(seeds)
+
+    # -- convenience lookups ------------------------------------------------
+
+    def functions_named(
+        self, name: str, top_level_only: bool = False
+    ) -> Iterator[Tuple[ModuleInfo, str, jaxast.FunctionNode]]:
+        """Every function whose bare name is ``name``, sorted."""
+        for mod in sorted(self.modules):
+            info = self.modules[mod]
+            for qual in sorted(info.functions):
+                if top_level_only and "." in qual:
+                    continue
+                if qual.split(".")[-1] == name:
+                    yield info, qual, info.functions[qual]
+
+    def classes_named(
+        self, name: str
+    ) -> Iterator[Tuple[ModuleInfo, str, ast.ClassDef]]:
+        for mod in sorted(self.modules):
+            info = self.modules[mod]
+            for qual in sorted(info.classes):
+                if qual.split(".")[-1] == name:
+                    yield info, qual, info.classes[qual]
+
+
+def load_project(paths, root) -> ProjectContext:
+    """Parse every ``.py`` under ``paths`` into a ProjectContext — the
+    standalone entry ``tools/tracelint.py --changed`` uses to compute the
+    reverse-dependency closure before the lint run proper."""
+    import os
+
+    from dlrover_tpu.analysis.engine import iter_python_files
+
+    root = os.path.abspath(root)
+    contexts: List[FileContext] = []
+    for file_path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(file_path), root)
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(file_path, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=file_path)
+        except (OSError, SyntaxError):
+            continue  # the engine run reports these; the graph skips them
+        contexts.append(FileContext(rel, source, tree))
+    return ProjectContext(contexts)

@@ -1,13 +1,16 @@
 """Built-in tracelint rules.  Importing this package registers them all."""
 
 from dlrover_tpu.analysis.rules import (  # noqa: F401  (registration imports)
+    cache_keys,
     compat,
     donation,
     host_sync,
+    locks,
     logfmt,
     retry_loops,
     seams,
     sharding,
+    telemetry_contract,
     threads,
     trace_purity,
 )

@@ -1,0 +1,489 @@
+"""CKY001: compiled-program cache keys must cover every program-shaping knob.
+
+The silent-wrong-program-reuse incident class: ``train_cache_key`` /
+``serve_cache_key`` name memoized compiled programs, and any knob that
+changes the lowered program but not the key hands a resized world (or a
+rebuilt serving engine) somebody else's executable.  PRs 4, 8, 17 and 19
+each re-pinned this contract with a hand-written "key covers knob X"
+test after the fact; this rule checks it structurally, project-wide.
+
+Three obligations, all resolved through the interprocedural
+:class:`~dlrover_tpu.analysis.project.ProjectContext`:
+
+1. **Signature parity** — every parameter of a *build entry*
+   (``build_sharded_train``, ``ServePrograms.__init__``,
+   ``get_programs``) must appear in the matching key function's
+   signature, modulo the structural parameters that ride the key another
+   way (``model``/``config`` fold via ``vars(model_config)``; ``mesh``
+   rides as ``mesh_shape``; ``cache_key``/``rules``/``self`` are the
+   plumbing itself).  A knob added to a build entry but not the key is
+   exactly the PR-19 MoE-dispatch aliasing bug.
+2. **Knob-read coverage** — inside any function that calls a key
+   function (directly or through a *key-reaching* wrapper that forwards
+   a parameter into one, e.g. ``decode._programs_key``) or a build
+   entry, every attribute read on a config carrier (``config``, ``cfg``,
+   ``model_config``, ...) must ride the key: as a key parameter, inside
+   a key call's argument expressions, or by the carrier being passed
+   whole into a key-reaching call (covered because the key folds
+   ``vars(model_config)`` — obligation 3).
+3. **vars() folding** — a key function taking a ``model_config`` must
+   fold it wholesale (``vars(model_config)``); if someone narrows that
+   to an explicit field list, every model-config field read upstream
+   instantly loses its blanket coverage and obligation 2 starts firing
+   per-field, which is the correct pressure.
+
+Suppress a deliberate exclusion inline with a reason, e.g. a knob that
+provably does not change the lowered program.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import Finding, ProjectRule, register
+from dlrover_tpu.analysis.project import (
+    FuncKey,
+    ModuleInfo,
+    ProjectContext,
+)
+
+#: Key functions, by bare top-level name, mapped to their family.
+KEY_FUNCTIONS: Dict[str, str] = {
+    "train_cache_key": "train",
+    "serve_cache_key": "serve",
+}
+
+#: Build entries: (bare name, is_class, family).
+BUILD_ENTRIES: Tuple[Tuple[str, bool, str], ...] = (
+    ("build_sharded_train", False, "train"),
+    ("ServePrograms", True, "serve"),
+    ("get_programs", False, "serve"),
+)
+
+#: Build-entry parameters that ride the key structurally rather than by
+#: name: the model/config carriers fold via ``vars(model_config)``, the
+#: mesh rides as its shape, and the rest are the memo plumbing itself.
+STRUCTURAL_PARAMS: Set[str] = {
+    "self", "model", "config", "model_config", "mesh", "rules",
+    "cache_key",
+}
+
+#: Parameter names treated as config carriers for knob-read coverage.
+CARRIER_NAMES: Set[str] = {
+    "config", "cfg", "model_config", "trainer_config", "serve_config",
+}
+
+
+def _param_names(fn: jaxast.FunctionNode) -> List[str]:
+    args = fn.args
+    return [
+        a.arg
+        for a in args.posonlyargs + args.args + args.kwonlyargs
+    ]
+
+
+def resolve_cache_key_signatures(
+    project: ProjectContext,
+) -> Dict[str, List[str]]:
+    """``{key function name: [parameter names]}`` for every key function
+    the project defines — the lint gate's non-vacuity probe: an empty or
+    partial map means CKY001 is not actually guarding the live keys."""
+    out: Dict[str, List[str]] = {}
+    for name in sorted(KEY_FUNCTIONS):
+        for _info, qual, fn in project.functions_named(
+            name, top_level_only=True
+        ):
+            out.setdefault(name, _param_names(fn))
+    return out
+
+
+def _carrier_split(dotted: str) -> Optional[Tuple[str, str]]:
+    """``("self.config", "lr")`` for a knob read ``self.config.lr``;
+    ``None`` when the dotted chain is not a carrier attribute read."""
+    parts = dotted.split(".")
+    if len(parts) < 2:
+        return None
+    owner = parts[-2].lstrip("_")
+    if owner in CARRIER_NAMES:
+        return ".".join(parts[:-1]), parts[-1]
+    return None
+
+
+def _maximal_dotted(expr: ast.AST) -> List[str]:
+    """Dotted names of the *maximal* name/attribute chains in ``expr`` —
+    ``config.lr`` yields ``config.lr``, never the inner ``config``, so a
+    field read does not masquerade as the carrier passed whole."""
+    inner: Set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            inner.add(id(node.value))
+    out: List[str] = []
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Name, ast.Attribute)) and (
+            id(node) not in inner
+        ):
+            dotted = jaxast.dotted_name(node)
+            if dotted:
+                out.append(dotted)
+    return out
+
+
+def _is_carrier(dotted: str) -> bool:
+    return _carrier_split(dotted + ".x") is not None
+
+
+def _is_model_config_carrier(carrier: str) -> bool:
+    """Carriers that denote the *model* config (folded wholesale into the
+    key via ``vars``): ``model_config``, ``self.model_config``,
+    ``model.config`` — but not the bare/trainer ``config`` spellings,
+    whose fields must ride the key individually."""
+    parts = carrier.split(".")
+    tail = parts[-1].lstrip("_")
+    if tail == "model_config":
+        return True
+    return tail in ("config", "cfg") and len(parts) >= 2 and (
+        parts[-2] != "self"
+    )
+
+
+@register
+class CacheKeyCoverage(ProjectRule):
+    id = "CKY001"
+    name = "cache-key-coverage"
+    description = (
+        "program-shaping knob not covered by the compile-cache key "
+        "(train_cache_key/serve_cache_key)"
+    )
+
+    def check_project(
+        self, project: ProjectContext
+    ) -> Iterator[Finding]:
+        keys = self._key_defs(project)
+        if not keys:
+            return  # nothing to guard in this tree (fixtures, subsets)
+        entries = self._build_entries(project)
+        key_params: Dict[str, Set[str]] = {
+            family: set(_param_names(fn))
+            for family, (_info, _qual, fn) in keys.items()
+        }
+        all_key_params = set().union(*key_params.values())
+
+        yield from self._check_vars_folding(keys)
+        yield from self._check_signature_parity(keys, entries)
+
+        key_funcs = {
+            (info.module, qual) for _f, (info, qual, _fn) in keys.items()
+        }
+        keyish = self._key_reaching(project, key_funcs)
+        entry_keys = {
+            (info.module, qual) for info, qual, _fn, _family in entries
+        }
+        vars_folded = {
+            family for family, (_i, _q, fn) in keys.items()
+            if self._folds_vars(fn)
+        }
+        # Only knobs that some key/build signature *names* are statically
+        # known to shape the compiled program; runtime-only config fields
+        # (checkpoint_dir, report_every, ...) are out of scope — flagging
+        # them would demand keying on knobs that never reach a trace.
+        knob_universe = all_key_params | {
+            p
+            for _i, _q, fn, _f in entries
+            for p in _param_names(fn)
+        } - STRUCTURAL_PARAMS
+        yield from self._check_knob_reads(
+            project, keyish | key_funcs, entry_keys, all_key_params,
+            vars_folded, knob_universe,
+        )
+
+    # -- resolution ---------------------------------------------------------
+
+    def _key_defs(
+        self, project: ProjectContext
+    ) -> Dict[str, Tuple[ModuleInfo, str, jaxast.FunctionNode]]:
+        out: Dict[str, Tuple[ModuleInfo, str, jaxast.FunctionNode]] = {}
+        for name, family in sorted(KEY_FUNCTIONS.items()):
+            for info, qual, fn in project.functions_named(
+                name, top_level_only=True
+            ):
+                out.setdefault(family, (info, qual, fn))
+        return out
+
+    def _build_entries(
+        self, project: ProjectContext
+    ) -> List[Tuple[ModuleInfo, str, jaxast.FunctionNode, str]]:
+        out: List[Tuple[ModuleInfo, str, jaxast.FunctionNode, str]] = []
+        for name, is_class, family in BUILD_ENTRIES:
+            if is_class:
+                for info, qual, _cls in project.classes_named(name):
+                    init = f"{qual}.__init__"
+                    fn = info.functions.get(init)
+                    if fn is not None:
+                        out.append((info, init, fn, family))
+            else:
+                for info, qual, fn in project.functions_named(
+                    name, top_level_only=True
+                ):
+                    out.append((info, qual, fn, family))
+        return out
+
+    # -- obligation 3: vars() folding ---------------------------------------
+
+    @staticmethod
+    def _folds_vars(fn: jaxast.FunctionNode) -> bool:
+        params = set(_param_names(fn))
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and jaxast.call_name(node) == "vars"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                return True
+        return False
+
+    def _check_vars_folding(self, keys) -> Iterator[Finding]:
+        for family in sorted(keys):
+            info, qual, fn = keys[family]
+            params = _param_names(fn)
+            carrier = next(
+                (p for p in params if p.lstrip("_") in CARRIER_NAMES),
+                None,
+            )
+            if carrier is not None and not self._folds_vars(fn):
+                yield info.ctx.finding(
+                    self.id, fn,
+                    f"{qual} takes {carrier!r} but never folds "
+                    f"vars({carrier}) into the key — model-config "
+                    "fields silently stop shaping the program name",
+                    symbol=f"{qual}::vars",
+                )
+
+    # -- obligation 1: signature parity -------------------------------------
+
+    def _check_signature_parity(self, keys, entries) -> Iterator[Finding]:
+        for info, qual, fn, family in entries:
+            if family not in keys:
+                continue
+            _kinfo, kqual, kfn = keys[family]
+            kparams = set(_param_names(kfn))
+            for param in _param_names(fn):
+                if param in kparams or param in STRUCTURAL_PARAMS:
+                    continue
+                yield info.ctx.finding(
+                    self.id, fn,
+                    f"build-entry parameter {qual}({param}) shapes the "
+                    f"compiled program but is not a parameter of "
+                    f"{kqual} — aliased programs on cache hit",
+                    symbol=f"{qual}::{param}",
+                )
+
+    # -- key-reaching closure -----------------------------------------------
+
+    def _key_reaching(
+        self, project: ProjectContext, key_funcs: Set[FuncKey]
+    ) -> Set[FuncKey]:
+        """Functions that forward one of their parameters (transitively)
+        into a key-function call — passing a carrier whole to one of
+        these puts its every field into the key.  One pass precomputes
+        the param-forwarding edges; the fixpoint then closes over them.
+        """
+        fwd: Dict[FuncKey, Set[FuncKey]] = {}
+        for mod in sorted(project.modules):
+            info = project.modules[mod]
+            for qual in sorted(info.functions):
+                fn = info.functions[qual]
+                params = set(_param_names(fn))
+                targets: Set[FuncKey] = set()
+                for node in jaxast.body_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = project.resolve_call(info, qual, node)
+                    if target is None:
+                        continue
+                    args = list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]
+                    if any(
+                        isinstance(sub, ast.Name) and sub.id in params
+                        for arg in args
+                        for sub in ast.walk(arg)
+                    ):
+                        targets.add(target)
+                if targets:
+                    fwd[(mod, qual)] = targets
+        reaching = set(key_funcs)
+        changed = True
+        while changed:
+            changed = False
+            for caller in sorted(fwd):
+                if caller not in reaching and fwd[caller] & reaching:
+                    reaching.add(caller)
+                    changed = True
+        return reaching
+
+    # -- obligation 2: knob-read coverage -----------------------------------
+
+    def _check_knob_reads(
+        self,
+        project: ProjectContext,
+        keyish: Set[FuncKey],
+        entry_keys: Set[FuncKey],
+        key_params: Set[str],
+        vars_folded: Set[str],
+        knob_universe: Set[str],
+    ) -> Iterator[Finding]:
+        covered_targets = keyish | entry_keys
+        for mod in sorted(project.modules):
+            info = project.modules[mod]
+            init_covered = self._init_covered_carriers(
+                project, info, covered_targets
+            )
+            for qual in sorted(info.functions):
+                fn = info.functions[qual]
+                covered_calls = self._covered_calls(
+                    project, info, qual, fn, covered_targets
+                )
+                if not covered_calls and (mod, qual) not in entry_keys:
+                    continue
+                inherited = init_covered.get(info.class_of(qual), set())
+                yield from self._check_function(
+                    info, qual, fn, covered_calls, key_params,
+                    vars_folded, inherited, knob_universe,
+                )
+
+    def _covered_calls(
+        self, project, info, qual, fn, covered_targets
+    ) -> List[ast.Call]:
+        out = []
+        for node in jaxast.body_nodes(fn):
+            if isinstance(node, ast.Call) and project.resolve_call(
+                info, qual, node
+            ) in covered_targets:
+                out.append(node)
+        return out
+
+    def _init_covered_carriers(
+        self, project, info, covered_targets
+    ) -> Dict[str, Set[str]]:
+        """Per class: ``self.*`` carriers whose ``__init__`` passes them
+        (or their source carrier) whole into a key-reaching call — those
+        fields ride the key for every method of the class."""
+        out: Dict[str, Set[str]] = {}
+        for cls in sorted(info.classes):
+            init = f"{cls}.__init__"
+            fn = info.functions.get(init)
+            if fn is None:
+                continue
+            calls = self._covered_calls(
+                project, info, init, fn, covered_targets
+            )
+            if not calls:
+                continue
+            whole = self._whole_carriers(fn, calls)
+            out[cls] = {c for c in whole if c.startswith("self.")}
+        return out
+
+    @staticmethod
+    def _whole_carriers(
+        fn: jaxast.FunctionNode, covered_calls: List[ast.Call]
+    ) -> Set[str]:
+        """Carrier dotted names passed whole into a covered call, closed
+        over same-function aliasing (``self.config =
+        decode_config(config)`` inherits ``config``'s coverage)."""
+        passed: Set[str] = set()
+        for call in covered_calls:
+            for arg in list(call.args) + [
+                kw.value for kw in call.keywords
+            ]:
+                passed.update(
+                    name for name in _maximal_dotted(arg)
+                    if _is_carrier(name)
+                )
+        # Alias fixpoint: target <- value when the value expression reads
+        # an already-covered carrier.
+        assigns: List[Tuple[str, Set[str]]] = []
+        for node in jaxast.body_nodes(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            sources = {
+                name for name in _maximal_dotted(node.value)
+                if _is_carrier(name)
+            }
+            for target in node.targets:
+                tname = jaxast.dotted_name(target)
+                if tname and _is_carrier(tname) and sources:
+                    assigns.append((tname, sources))
+        changed = True
+        while changed:
+            changed = False
+            for tname, sources in assigns:
+                if tname not in passed and sources & passed:
+                    passed.add(tname)
+                    changed = True
+        return passed
+
+    def _check_function(
+        self,
+        info: ModuleInfo,
+        qual: str,
+        fn: jaxast.FunctionNode,
+        covered_calls: List[ast.Call],
+        key_params: Set[str],
+        vars_folded: Set[str],
+        inherited: Set[str],
+        knob_universe: Set[str],
+    ) -> Iterator[Finding]:
+        in_call_args: Set[int] = set()
+        for call in covered_calls:
+            for arg in list(call.args) + [
+                kw.value for kw in call.keywords
+            ]:
+                for sub in ast.walk(arg):
+                    in_call_args.add(id(sub))
+        whole = self._whole_carriers(fn, covered_calls) | inherited
+
+        seen: Set[str] = set()
+        for node in jaxast.body_nodes(fn):
+            if not isinstance(node, ast.Attribute) or not isinstance(
+                node.ctx, ast.Load
+            ):
+                continue
+            dotted = jaxast.dotted_name(node)
+            split = _carrier_split(dotted) if dotted else None
+            if split is None:
+                continue
+            carrier, attr = split
+            if attr.startswith("__") or f"{carrier}.{attr}" in seen:
+                continue
+            if attr not in knob_universe:
+                continue  # not a knob any key/build signature names
+            if attr in key_params:
+                continue
+            if id(node) in in_call_args:
+                continue
+            if carrier in whole:
+                continue
+            if self._call_receiver(fn, node):
+                continue  # config.replace(...) — a method, not a knob
+            if _is_model_config_carrier(carrier) and vars_folded:
+                continue  # rides wholesale via vars(model_config)
+            seen.add(f"{carrier}.{attr}")
+            yield info.ctx.finding(
+                self.id, node,
+                f"{qual} reads {carrier}.{attr} on a program-build path "
+                f"but the read does not ride the compile-cache key — "
+                "add it to the key signature or fold the carrier whole",
+                symbol=f"{qual}::{carrier}.{attr}",
+            )
+
+    @staticmethod
+    def _call_receiver(fn: jaxast.FunctionNode, node: ast.AST) -> bool:
+        """Is ``node`` the callee of a Call (``config.method(...)``)?"""
+        for parent in ast.walk(fn):
+            if isinstance(parent, ast.Call) and parent.func is node:
+                return True
+        return False

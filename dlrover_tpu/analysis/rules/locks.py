@@ -1,0 +1,292 @@
+"""LCK001: real lockset analysis over the CFG — THR001's upgrade.
+
+THR001 asks a lexical yes/no question ("is this write inside a ``with
+self._lock`` block?"), which misses two real race shapes and mislabels
+one safe one:
+
+* **disjoint locksets** — the write holds ``self._state_lock`` while the
+  reader holds ``self._io_lock``: both sides are "locked" to THR001, but
+  the locks don't exclude each other and the race is intact.  This is
+  the shape the lexical heuristic cannot express at all.
+* **inconsistent guard** — most accesses of an attribute take the lock,
+  one write path doesn't.  THR001 catches the bare write only when it
+  can also see a cross-thread access; the lockset framing makes the
+  *inconsistency itself* the signal.
+* **``acquire()``/``release()`` pairs** — a try/finally acquire is a
+  perfectly held lock, but lexical ``with``-matching calls it unlocked
+  (a THR001 false positive this rule does not repeat).
+
+The lockset at an access is the union of two sources over one method:
+
+1. the lexical ``with self.<lockish>`` stack enclosing the access, and
+2. a forward **must-hold** dataflow over :class:`FunctionDataflow`'s
+   statement CFG — gen at ``self.X.acquire()``, kill at
+   ``self.X.release()``, entry set empty, meet = intersection (a lock
+   only *must* be held if it is held on every path in).
+
+Scope mirrors THR001: classes that start a ``threading.Thread``, with
+the same thread-side/caller-side split and the same thread-safe-type
+exemptions.  To stay out of THR001's lane, an attribute is only
+examined when at least one of its accesses holds a non-empty lockset —
+fully unguarded attributes remain THR001's finding.  Lock-free *reads*
+of a consistently-guarded attribute stay accepted (single-word reads
+under the GIL), matching THR001's contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from dlrover_tpu.analysis import jaxast
+from dlrover_tpu.analysis.core import FileContext, Finding, Rule, register
+from dlrover_tpu.analysis.dataflow import (
+    FunctionDataflow,
+    own_expr_nodes,
+)
+from dlrover_tpu.analysis.rules.threads import _ClassInfo, _is_lockish
+
+
+@dataclasses.dataclass
+class _LockedAccess:
+    node: ast.AST
+    attr: str
+    is_write: bool
+    lockset: FrozenSet[str]
+    where: str  # qualified method/closure name
+    side: str  # "thread" | "caller"
+
+
+def _acquire_release(
+    stmt: ast.stmt, lock_attrs: Set[str]
+) -> Tuple[Set[str], Set[str]]:
+    """Lock attrs this statement itself acquires/releases via method
+    calls (``self._lock.acquire()`` / ``.release()``)."""
+    acq: Set[str] = set()
+    rel: Set[str] = set()
+    for node in own_expr_nodes(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        name = jaxast.call_name(node)
+        parts = name.split(".")
+        if len(parts) != 3 or parts[0] != "self":
+            continue
+        attr, method = parts[1], parts[2]
+        if not _is_lockish(attr, lock_attrs):
+            continue
+        if method == "acquire":
+            acq.add(attr)
+        elif method == "release":
+            rel.add(attr)
+    return acq, rel
+
+
+def _lexical_locks(
+    fn: jaxast.FunctionNode,
+    df: FunctionDataflow,
+    lock_attrs: Set[str],
+) -> Dict[int, Set[str]]:
+    """Statement index -> lock attrs held by enclosing ``with`` blocks.
+    The ``with`` statement itself is *outside* its own lock (the
+    context expression runs before acquisition)."""
+    held: Dict[int, Set[str]] = {}
+
+    def walk(node: ast.AST, stack: List[str]):
+        idx = df.index_of(node)
+        if idx is not None:
+            held[idx] = set(stack)
+        pushed = 0
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                name = jaxast.dotted_name(expr)
+                if not name and isinstance(expr, ast.Call):
+                    name = jaxast.dotted_name(expr.func)
+                if name.startswith("self."):
+                    attr = name[len("self."):].split(".")[0]
+                    if _is_lockish(attr, lock_attrs):
+                        stack.append(attr)
+                        pushed += 1
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, jaxast.FUNCTION_NODES):
+                continue  # nested defs are their own scope
+            walk(child, stack)
+        for _ in range(pushed):
+            stack.pop()
+
+    walk(fn, [])
+    return held
+
+
+def _must_hold(
+    df: FunctionDataflow, lock_attrs: Set[str]
+) -> Dict[int, Set[str]]:
+    """Forward must-analysis: locks held on *every* CFG path into each
+    statement.  Entry holds nothing; meet is intersection, so the
+    classic optimistic init (everything held) converges downward."""
+    n = len(df.statements)
+    gen: Dict[int, Set[str]] = {}
+    kill: Dict[int, Set[str]] = {}
+    universe: Set[str] = set()
+    for i, stmt in enumerate(df.statements):
+        gen[i], kill[i] = _acquire_release(stmt, lock_attrs)
+        universe |= gen[i]
+    if not universe:
+        return {i: set() for i in range(n)}
+
+    preds: Dict[int, Set[int]] = {}
+    for i, succs in df.succ.items():
+        for j in succs:
+            preds.setdefault(j, set()).add(i)
+
+    in_sets = {i: set(universe) for i in range(n)}
+    out_sets = {i: set(universe) for i in range(n)}
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n):
+            ps = [p for p in preds.get(i, ()) if 0 <= p < n]
+            if ps:
+                new_in = set(universe)
+                for p in ps:
+                    new_in &= out_sets[p]
+            else:
+                new_in = set()  # function entry: nothing held
+            new_out = (new_in - kill[i]) | gen[i]
+            if new_in != in_sets[i] or new_out != out_sets[i]:
+                in_sets[i] = new_in
+                out_sets[i] = new_out
+                changed = True
+    return in_sets
+
+
+def _accesses(
+    owner: str,
+    fn: jaxast.FunctionNode,
+    lock_attrs: Set[str],
+    side: str,
+) -> Iterator[_LockedAccess]:
+    df = FunctionDataflow(fn)
+    lexical = _lexical_locks(fn, df, lock_attrs)
+    holding = _must_hold(df, lock_attrs)
+    for node in jaxast.body_nodes(fn):
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            continue
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        stmt = df.statement_for(node)
+        idx = df.index_of(stmt) if stmt is not None else None
+        lockset: Set[str] = set()
+        if idx is not None:
+            lockset = lexical.get(idx, set()) | holding.get(idx, set())
+        yield _LockedAccess(
+            node, node.attr, is_write, frozenset(lockset), owner, side
+        )
+
+
+@register
+class LocksetRace(Rule):
+    id = "LCK001"
+    name = "lockset-race"
+    description = (
+        "cross-thread attribute guarded inconsistently: a write holds "
+        "no lock (or a disjoint lock) relative to the accesses it races"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(
+        self, ctx: FileContext, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        info = _ClassInfo(cls)
+        thread_side = info.thread_side()
+        if not thread_side:
+            return
+        safe_attrs, lock_attrs = info.threadsafe_attrs()
+
+        per_attr: Dict[str, List[_LockedAccess]] = {}
+        for name, fn in info.methods.items():
+            if name == "__init__":
+                continue  # runs before any thread exists
+            side = "thread" if name in thread_side else "caller"
+            for acc in _accesses(name, fn, lock_attrs, side):
+                per_attr.setdefault(acc.attr, []).append(acc)
+        for name, (owner, fn) in info.closures.items():
+            side = (
+                "thread"
+                if name in thread_side or owner in thread_side
+                else "caller"
+            )
+            for acc in _accesses(
+                f"{owner}.{name}", fn, lock_attrs, side
+            ):
+                per_attr.setdefault(acc.attr, []).append(acc)
+
+        for attr in sorted(per_attr):
+            if attr in safe_attrs or _is_lockish(attr, lock_attrs):
+                continue
+            accs = per_attr[attr]
+            guarded = [a for a in accs if a.lockset]
+            if not guarded:
+                continue  # fully unguarded attribute: THR001's finding
+            if not any(a.side == "thread" for a in accs) or not any(
+                a.side == "caller" for a in accs
+            ):
+                continue  # single-threaded attribute: no race
+            finding = self._attr_finding(ctx, cls, attr, accs, guarded)
+            if finding is not None:
+                yield finding
+
+    def _attr_finding(
+        self,
+        ctx: FileContext,
+        cls: ast.ClassDef,
+        attr: str,
+        accs: List[_LockedAccess],
+        guarded: List[_LockedAccess],
+    ) -> Optional[Finding]:
+        # (a) inconsistent guard: a bare write while other accesses of
+        # the same attribute do take a lock.
+        for acc in accs:
+            if acc.is_write and not acc.lockset:
+                other = guarded[0]
+                locks = "/".join(sorted(other.lockset))
+                return ctx.finding(
+                    self.id, acc.node,
+                    f"{cls.name}.{attr} written in {acc.where!r} with "
+                    f"an empty lockset while {other.where!r} guards it "
+                    f"with self.{locks} — every write of a guarded "
+                    "attribute must hold the lock",
+                    symbol=f"{cls.name}.{attr}",
+                )
+        # (b) disjoint locksets: a guarded write and a guarded access on
+        # the opposite side share no lock — the guards don't exclude
+        # each other.
+        for w in accs:
+            if not w.is_write or not w.lockset:
+                continue
+            for other in accs:
+                if (
+                    other.side != w.side
+                    and other.lockset
+                    and not (w.lockset & other.lockset)
+                ):
+                    w_locks = "/".join(sorted(w.lockset))
+                    o_locks = "/".join(sorted(other.lockset))
+                    return ctx.finding(
+                        self.id, w.node,
+                        f"{cls.name}.{attr} written in {w.where!r} "
+                        f"under self.{w_locks} while {other.where!r} "
+                        f"{'writes' if other.is_write else 'reads'} it "
+                        f"under self.{o_locks} — disjoint locksets do "
+                        "not exclude each other",
+                        symbol=f"{cls.name}.{attr}",
+                    )
+        return None

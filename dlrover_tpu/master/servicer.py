@@ -24,6 +24,19 @@ SERVICE = "dlrover_tpu.Master"
 REPORT = f"/{SERVICE}/report"
 GET = f"/{SERVICE}/get"
 
+#: Instant (occurrence-only) telemetry kinds routed straight into a
+#: timeline counter: event name -> counter, rendered by render_metrics
+#: as ``dlrover_<counter>_total``.  Anything not in this table and not
+#: handled by a ledger branch below lands in the timeline ring only,
+#: which TEL001 (telemetry-contract) flags as an unrouted event.
+_COUNTER_KINDS: Dict[str, str] = {
+    "retry": "retries",
+    "circuit_open": "circuit_opens",
+    "replica.death": "replica_deaths",
+    "process_exit": "worker_exits",
+    "worker_start": "worker_starts",
+}
+
 
 class MasterServicer:
     """Dispatches report/get payloads to the master components."""
@@ -391,6 +404,12 @@ class MasterServicer:
                         "unparseable embed event from %d: %r",
                         node, attrs,
                     )
+            elif name in _COUNTER_KINDS:
+                # Occurrence-only events (retries, breaker trips, worker
+                # lifecycle): one counter bump each, surfaced as
+                # dlrover_*_total so reliability dashboards see them
+                # without scraping the timeline ring.
+                self.timeline.bump(_COUNTER_KINDS[name])
             elif name == "memory":
                 # Classified HBM snapshot (utils/memory_profile emits
                 # them on the report cadence): newest-wins per node in

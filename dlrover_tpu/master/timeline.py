@@ -416,10 +416,25 @@ class JobTimeline:
         with self._lock:
             dropped = self._counters.get("telemetry_dropped", 0)
             regressions = self._counters.get("perf_regressions", 0)
+            retries = self._counters.get("retries", 0)
+            circuit_opens = self._counters.get("circuit_opens", 0)
+            replica_deaths = self._counters.get("replica_deaths", 0)
+            worker_exits = self._counters.get("worker_exits", 0)
+            worker_starts = self._counters.get("worker_starts", 0)
         gauge("dlrover_telemetry_dropped_total", dropped,
               "events the node telemetry rings overwrote before a drain")
         gauge("dlrover_perf_regressions_total", regressions,
               "step-time regressions flagged by the diagnosis sentinel")
+        gauge("dlrover_retries_total", retries,
+              "RetryPolicy attempts that failed and were retried")
+        gauge("dlrover_circuit_opens_total", circuit_opens,
+              "circuit-breaker trips (failure threshold reached)")
+        gauge("dlrover_replica_deaths_total", replica_deaths,
+              "serving replicas killed or declared dead by the fleet")
+        gauge("dlrover_worker_exits_total", worker_exits,
+              "training worker process exits the agent observed")
+        gauge("dlrover_worker_starts_total", worker_starts,
+              "training worker process launches the agent performed")
         stats = self.step_stats()
         if stats:
             lines.append(

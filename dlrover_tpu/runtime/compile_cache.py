@@ -127,6 +127,7 @@ def train_cache_key(
     overlap: bool = False,
     overlap_bucket_mb: float = 0.0,
     allgather_quant: str = "none",
+    donate_state: bool = True,
     logical_shape=(),
 ) -> str:
     """Name the compiled train program by everything that shapes it.
@@ -139,7 +140,9 @@ def train_cache_key(
     change the accumulator and reduce lowering; zero1 reshards the whole
     optimizer update; the overlap-engine knobs move the zero1 collectives
     into the scan and re-bucket the wave schedule — aliasing any of them
-    would hand a resized world the wrong executable).
+    would hand a resized world the wrong executable).  ``donate_state``
+    flips input/output buffer aliasing of the whole step program, so a
+    donating and a non-donating build may not share an executable either.
 
     ``logical_shape`` is the virtual mesh's resize-INVARIANT bit
     (``VirtualMesh.logical_shape``: the per-process mesh scaled by the
@@ -156,7 +159,7 @@ def train_cache_key(
         global_batch_size, seq_len, ce_chunks, optimizer,
         grad_accum, accum_dtype, reduce_quant, zero1,
         overlap, float(overlap_bucket_mb), allgather_quant,
-        tuple(logical_shape),
+        donate_state, tuple(logical_shape),
     ))
 
 

@@ -359,3 +359,185 @@ def test_self_attr_helper():
     assert dataflow.self_attr(node) == "self.cache"
     other = ast.parse("obj.cache", mode="eval").body
     assert dataflow.self_attr(other) == ""
+
+
+# -- interprocedural layer: ProjectContext ---------------------------------
+
+def _project(files):
+    """Build a ProjectContext from {rel_path: source} in memory."""
+    from dlrover_tpu.analysis.core import FileContext
+    from dlrover_tpu.analysis.project import ProjectContext
+
+    contexts = [
+        FileContext(rel, textwrap.dedent(src), ast.parse(
+            textwrap.dedent(src)
+        ))
+        for rel, src in files.items()
+    ]
+    return ProjectContext(contexts)
+
+
+def test_module_name_for_paths():
+    from dlrover_tpu.analysis.project import module_name_for
+
+    assert module_name_for("pkg/mod.py") == "pkg.mod"
+    assert module_name_for("pkg/sub/__init__.py") == "pkg.sub"
+    assert module_name_for("top.py") == "top"
+
+
+def test_cross_module_call_edge():
+    project = _project({
+        "pkg/util.py": """
+            def helper(x):
+                return x + 1
+        """,
+        "pkg/app.py": """
+            from pkg.util import helper
+
+            def run(x):
+                return helper(x)
+        """,
+    })
+    graph = project.call_graph()
+    assert ("pkg.util", "helper") in graph[("pkg.app", "run")]
+
+
+def test_import_alias_resolution():
+    project = _project({
+        "pkg/util.py": """
+            def helper(x):
+                return x
+        """,
+        "pkg/app.py": """
+            from pkg.util import helper as h
+            from pkg import util as u
+
+            def run(x):
+                return h(u.helper(x))
+        """,
+    })
+    edges = project.call_graph()[("pkg.app", "run")]
+    assert edges == {("pkg.util", "helper")}
+
+
+def test_relative_import_resolution():
+    project = _project({
+        "pkg/util.py": """
+            def helper(x):
+                return x
+        """,
+        "pkg/app.py": """
+            from .util import helper
+
+            def run(x):
+                return helper(x)
+        """,
+    })
+    assert ("pkg.util", "helper") in project.call_graph()[
+        ("pkg.app", "run")
+    ]
+
+
+def test_reexport_following():
+    project = _project({
+        "pkg/__init__.py": """
+            from pkg.util import helper
+        """,
+        "pkg/util.py": """
+            def helper(x):
+                return x
+        """,
+        "app.py": """
+            from pkg import helper
+
+            def run(x):
+                return helper(x)
+        """,
+    })
+    assert ("pkg.util", "helper") in project.call_graph()[("app", "run")]
+
+
+def test_import_cycle_is_tolerated():
+    """Mutually re-exporting modules must not recurse forever."""
+    project = _project({
+        "a.py": """
+            from b import thing
+        """,
+        "b.py": """
+            from a import thing
+        """,
+        "app.py": """
+            from a import thing
+
+            def run():
+                return thing()
+        """,
+    })
+    # Resolution terminates with None rather than looping.
+    assert project.resolve("app", "thing") is None
+    assert project.call_graph()[("app", "run")] == set()
+
+
+def test_self_method_and_constructor_edges():
+    project = _project({
+        "m.py": """
+            class Engine:
+                def __init__(self, n):
+                    self.n = n
+
+                def step(self):
+                    return self.warm()
+
+                def warm(self):
+                    return self.n
+
+            def make():
+                return Engine(4)
+        """,
+    })
+    graph = project.call_graph()
+    assert ("m", "Engine.warm") in graph[("m", "Engine.step")]
+    assert ("m", "Engine.__init__") in graph[("m", "make")]
+
+
+def test_reverse_import_closure():
+    project = _project({
+        "pkg/base.py": """
+            def f():
+                return 1
+        """,
+        "pkg/mid.py": """
+            from pkg.base import f
+        """,
+        "pkg/top.py": """
+            from pkg.mid import f
+        """,
+        "pkg/other.py": """
+            def g():
+                return 2
+        """,
+    })
+    closure = project.reverse_import_closure(["pkg/base.py"])
+    assert closure == {"pkg/base.py", "pkg/mid.py", "pkg/top.py"}
+
+
+def test_trace_entry_closure_crosses_modules():
+    """jaxast's intra-module trace closure, lifted to package scope: a
+    helper one import away from the jitted entry is traced too."""
+    project = _project({
+        "pkg/math.py": """
+            def helper(x):
+                return x * 2
+        """,
+        "pkg/train.py": """
+            import jax
+            from pkg.math import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """,
+    })
+    closure = project.trace_entry_closure()
+    assert ("pkg.train", "step") in closure
+    assert ("pkg.math", "helper") in closure

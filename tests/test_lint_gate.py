@@ -24,10 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRACELINT = os.path.join(REPO, "tools", "tracelint.py")
 
 #: Rules the gate expects to be live; extend when adding a rule.
-EXPECTED_RULES = 12
+EXPECTED_RULES = 15
 
 
-@pytest.mark.slow  # walks every repo file through all 12 rules, ~29s on 1 core
+@pytest.mark.slow  # walks every repo file through all 15 rules, ~30s on 1 core
 def test_tracelint_self_hosting_gate(cpu_child_env):
     proc = subprocess.run(
         [sys.executable, TRACELINT,
@@ -45,6 +45,80 @@ def test_tracelint_self_hosting_gate(cpu_child_env):
     # The package alone is ~100 files; a collapsed walk would show here.
     assert payload["files_checked"] >= 100
     assert payload["findings"] == []
+
+
+def test_project_rules_self_host_clean(cpu_child_env):
+    """The interprocedural rules (cache-key coverage, telemetry
+    contract, locksets) pass over the live tree without the slow full
+    gate: the whole-repo symbol table and call graph build in seconds,
+    so this contract is checked on every non-slow run."""
+    proc = subprocess.run(
+        [sys.executable, TRACELINT,
+         os.path.join(REPO, "dlrover_tpu"), os.path.join(REPO, "tools"),
+         "--select", "CKY001,TEL001,LCK001", "--json"],
+        capture_output=True, text=True, timeout=120, env=cpu_child_env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["files_checked"] >= 100
+
+
+def test_cky001_resolves_both_live_cache_keys():
+    """Non-vacuity probe: CKY001 is only guarding the compile-cache
+    contract if it actually found and parsed the live key signatures.
+    An import-graph or symbol-table regression that silently lost
+    train_cache_key/serve_cache_key would otherwise read as 'clean'."""
+    from dlrover_tpu.analysis.project import load_project
+    from dlrover_tpu.analysis.rules.cache_keys import (
+        resolve_cache_key_signatures,
+    )
+
+    project = load_project([os.path.join(REPO, "dlrover_tpu")], REPO)
+    sigs = resolve_cache_key_signatures(project)
+    assert set(sigs) == {"train_cache_key", "serve_cache_key"}
+    train = set(sigs["train_cache_key"])
+    assert {"zero1", "overlap", "allgather_quant", "donate_state",
+            "grad_accum"} <= train
+    serve = set(sigs["serve_cache_key"])
+    assert {"tp", "spec", "attention_impl", "slots"} <= serve
+
+
+def test_cky001_fires_when_fixture_key_omits_a_knob(tmp_path):
+    """A knob deliberately left out of a fake cache key MUST fail —
+    proves the rule has teeth, not just that the live tree is clean."""
+    from dlrover_tpu.analysis import run_paths
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "keys.py").write_text(textwrap.dedent(
+        """
+        def train_cache_key(model_config, mesh_shape, *,
+                            global_batch_size):
+            fields = tuple(sorted(vars(model_config).items()))
+            return repr((fields, tuple(mesh_shape), global_batch_size))
+        """
+    ))
+    (pkg / "build.py").write_text(textwrap.dedent(
+        """
+        from pkg.keys import train_cache_key
+
+        def build_sharded_train(model, mesh, *, global_batch_size,
+                                zero1=False, cache_key=None):
+            key = cache_key or train_cache_key(
+                model.config, mesh.shape,
+                global_batch_size=global_batch_size,
+            )
+            return key, zero1
+        """
+    ))
+    report = run_paths(
+        [str(tmp_path)], select=["CKY001"], root=str(tmp_path)
+    )
+    assert any(
+        f.symbol == "build_sharded_train::zero1" for f in report.findings
+    ), [f.render() for f in report.findings]
 
 
 def test_shipped_baseline_is_empty():
@@ -77,6 +151,27 @@ def test_write_baseline_is_deterministic(tmp_path, cpu_child_env):
             os.replace(path + ".tmp", path)
         """
     ))
+    (fixture_dir / "racy.py").write_text(textwrap.dedent(
+        """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._value = 0
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                while True:
+                    with self._a_lock:
+                        self._value += 1
+
+            def snapshot(self):
+                with self._b_lock:
+                    return self._value
+        """
+    ))
     outputs = []
     for run in range(2):
         baseline = tmp_path / f"baseline_{run}.json"
@@ -94,6 +189,7 @@ def test_write_baseline_is_deterministic(tmp_path, cpu_child_env):
     assert entries, "fixture should have produced baseline entries"
     rules = {e["rule"] for e in entries}
     assert "SHD001" in rules and "SEAM001" in rules
+    assert "LCK001" in rules, rules
 
 
 def _ruff_command():

@@ -243,9 +243,13 @@ def test_moe_stats_harvest():
 
 
 def test_train_cache_key_covers_moe_knobs():
-    """Every MoE knob must shape the compiled-program name: aliasing a
-    dispatch or expert-count change would hand a resized world the wrong
-    executable."""
+    """Single witness that MoE knobs shape the compiled-program name.
+
+    Exhaustive knob-by-knob pinning now lives in tracelint's CKY001
+    (cache-key coverage, tests/test_lint_gate.py): the rule resolves
+    ``train_cache_key``'s signature and proves every program-shaping
+    knob reaches the key, so hand-enumerating them here only duplicated
+    that contract one knob behind."""
     from dlrover_tpu.runtime.compile_cache import train_cache_key
 
     def key(config):
@@ -255,10 +259,5 @@ def test_train_cache_key_covers_moe_knobs():
         )
 
     base = _moe_config("a2a")
-    assert key(base) != key(_moe_config("a2a_int8"))
-    assert key(base) != key(_moe_config("einsum"))
-    assert key(base) != key(_moe_config("a2a", num_experts=4))
-    assert key(base) != key(
-        _moe_config("a2a", capacity_factor=2.0)
-    )
     assert key(base) == key(_moe_config("a2a"))
+    assert key(base) != key(_moe_config("a2a_int8"))

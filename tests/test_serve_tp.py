@@ -119,6 +119,8 @@ def test_tp_mesh_fold_preserves_logical_shape():
 
 
 def test_serve_cache_key_covers_tp_and_spec_knobs(setup):
+    # Single witness; knob-by-knob coverage of serve_cache_key is
+    # enforced statically by tracelint CKY001 (tests/test_lint_gate.py).
     config, _ = setup
 
     def key(**kw):
@@ -127,10 +129,7 @@ def test_serve_cache_key_covers_tp_and_spec_knobs(setup):
 
     base = key()
     assert key() == base
-    assert key(tp=(2, 2)) != base
     assert key(tp=(2, 2)) != key(tp=(2, 1))  # re-fold = new programs
-    assert key(spec=3) != base
-    assert key(attention_impl="flash") != base
 
 
 # -- TP decode parity + sharding ----------------------------------------------

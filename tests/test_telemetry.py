@@ -302,6 +302,38 @@ def test_embed_event_routes_through_servicer_into_gauges():
     assert metrics["dlrover_embed_rows_per_s"] == 50_000
 
 
+def test_instant_fault_events_route_into_counter_gauges():
+    """Instant fault-plane events (retry, circuit_open, replica.death,
+    process_exit, worker_start) bump timeline counters and render as
+    HELP'd ``dlrover_*_total`` gauges — the TEL001 telemetry contract:
+    no emitted event kind may die unrouted in the servicer."""
+    sm = SpeedMonitor()
+    timeline = JobTimeline()
+    servicer = MasterServicer(speed_monitor=sm, timeline=timeline)
+    kinds = ("retry", "circuit_open", "replica.death", "process_exit",
+             "worker_start", "worker_start")
+    wire = pickle.dumps(msg.Envelope(
+        node_id=1,
+        payload=msg.TelemetryEvents(
+            1, tuple((k, "event", 0.0, 0.0, {}) for k in kinds)
+        ),
+    ))
+    assert servicer.report(msg.safe_loads(wire)).success
+    text = timeline.render_metrics(speed_monitor=sm)
+    metrics = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, value = line.rsplit(" ", 1)
+            metrics[key] = float(value)
+    assert metrics["dlrover_retries_total"] == 1
+    assert metrics["dlrover_circuit_opens_total"] == 1
+    assert metrics["dlrover_replica_deaths_total"] == 1
+    assert metrics["dlrover_worker_exits_total"] == 1
+    assert metrics["dlrover_worker_starts_total"] == 2
+    for name in ("dlrover_retries_total", "dlrover_worker_starts_total"):
+        assert f"# HELP {name} " in text
+
+
 def test_embed_ledger_newest_wins_max_aggregation_and_state():
     """Per-node snapshots are newest-wins; the fleet aggregate takes the
     max of plane-global counters (every reporter sees the same plane) and

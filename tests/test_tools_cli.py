@@ -133,6 +133,77 @@ def test_tracelint_sarif_smoke(tmp_path, cpu_child_env):
     assert any(r["ruleId"] == "SHD001" for r in run["results"])
 
 
+def test_tracelint_help_smoke(cpu_child_env):
+    """``tracelint --help`` exits 0 and advertises the incremental mode."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         "--help"],
+        capture_output=True, text=True, timeout=60, env=cpu_child_env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--changed" in out.stdout
+    assert "--write-baseline" in out.stdout
+
+
+def test_tracelint_changed_mode(tmp_path, cpu_child_env):
+    """``--changed`` lints only the git-diffed files plus their
+    reverse-import closure: an edit to a leaf module re-lints its
+    importers, while unrelated dirty files stay untouched."""
+    repo = tmp_path / "proj"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    bad_spec = (
+        "from jax.sharding import PartitionSpec as P\n"
+        'SPEC = P("dp", "tesnor")\n'
+    )
+    (pkg / "base.py").write_text("def f():\n    return 1\n")
+    (pkg / "mid.py").write_text(
+        "from pkg.base import f\n" + bad_spec +
+        "\ndef g():\n    return f()\n"
+    )
+    (pkg / "loner.py").write_text(bad_spec)
+    git = ["git", "-C", str(repo)]
+    env = dict(cpu_child_env)
+    env.update({
+        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+    })
+    for cmd in (["init", "-q"], ["add", "-A"],
+                ["commit", "-q", "-m", "seed"]):
+        proc = subprocess.run(
+            git + cmd, capture_output=True, text=True, timeout=60,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+    # Dirty the leaf only; mid.py (imports it) must ride the closure,
+    # loner.py must not.
+    (pkg / "base.py").write_text("def f():\n    return 2\n")
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         str(pkg), "--root", str(repo), "--no-baseline", "--changed",
+         "--select", "SHD001", "--json"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    payload = json.loads(out.stdout)
+    flagged = {f["path"] for f in payload["findings"]}
+    assert "pkg/mid.py" in flagged, out.stdout + out.stderr
+    assert "pkg/loner.py" not in flagged
+
+    # A clean tree short-circuits: nothing changed, nothing linted.
+    subprocess.run(git + ["add", "-A"], capture_output=True, timeout=60,
+                   env=env)
+    subprocess.run(git + ["commit", "-q", "-m", "fix"],
+                   capture_output=True, timeout=60, env=env)
+    out2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         str(pkg), "--root", str(repo), "--no-baseline", "--changed"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out2.returncode == 0, out2.stdout + out2.stderr
+    assert "nothing to lint" in out2.stdout
+
+
 def test_serve_bench_gate_predicate():
     """The serve_bench ok gate is a pure predicate: rc 1 exactly when a
     check fails, and the failed check is named."""

@@ -33,6 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALL_RULE_IDS = {
     "TRC001", "TRC002", "TRC003", "CMP001", "THR001", "LOG001", "RTY001",
     "DON001", "DON002", "SHD001", "SHD002", "SEAM001",
+    "CKY001", "TEL001", "LCK001",
 }
 
 
@@ -42,6 +43,19 @@ def lint(tmp_path, name, source, select=None, baseline=None):
     path.write_text(source)
     return run_paths(
         [str(path)], select=select, baseline=baseline, root=str(tmp_path)
+    )
+
+
+def lint_files(tmp_path, files, select=None, baseline=None):
+    """Write a whole fixture tree and analyze it — the project-scope
+    rules (CKY001/TEL001) need several modules linked by imports."""
+    for name, source in files.items():
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    return run_paths(
+        [str(tmp_path)], select=select, baseline=baseline,
+        root=str(tmp_path),
     )
 
 
@@ -902,3 +916,491 @@ def test_cli_write_baseline_round_trip(tmp_path, cpu_child_env):
         cpu_child_env,
     )
     assert clean.returncode == EXIT_CLEAN, clean.stdout
+
+
+# -- CKY001: cache-key coverage (project scope) ----------------------------
+
+CKY_KEYS = """\
+def train_cache_key(model_config, mesh_shape, *, global_batch_size,
+                    seq_len, zero1=False):
+    fields = tuple(sorted(
+        (k, repr(v)) for k, v in vars(model_config).items()
+    ))
+    return repr((fields, tuple(mesh_shape), global_batch_size, seq_len,
+                 zero1))
+"""
+
+CKY_BUILD_OK = """\
+from pkg.keys import train_cache_key
+
+def build_sharded_train(model, mesh, *, global_batch_size, seq_len,
+                        zero1=False, cache_key=None):
+    key = cache_key or train_cache_key(
+        model.config, mesh.shape, global_batch_size=global_batch_size,
+        seq_len=seq_len, zero1=zero1,
+    )
+    return key
+"""
+
+# ``overlap`` shapes the program (a build-entry parameter) but is absent
+# from train_cache_key's signature — the PR-19 aliasing shape.
+CKY_BUILD_PARITY_BAD = """\
+from pkg.keys import train_cache_key
+
+def build_sharded_train(model, mesh, *, global_batch_size, seq_len,
+                        zero1=False, overlap=False, cache_key=None):
+    key = cache_key or train_cache_key(
+        model.config, mesh.shape, global_batch_size=global_batch_size,
+        seq_len=seq_len, zero1=zero1,
+    )
+    return key, overlap
+"""
+
+# A build-path function reads config.overlap — a knob the build entry
+# names but the key does not — outside any key-call argument.
+CKY_READ_BAD = """\
+from pkg.build import build_sharded_train
+
+def make_programs(config, model, mesh):
+    overlap = config.overlap
+    return build_sharded_train(
+        model, mesh, global_batch_size=8, seq_len=16,
+    ), overlap
+"""
+
+CKY_READ_SUPPRESSED = """\
+from pkg.build import build_sharded_train
+
+def make_programs(config, model, mesh):
+    overlap = config.overlap  # tracelint: disable=CKY001
+    return build_sharded_train(
+        model, mesh, global_batch_size=8, seq_len=16,
+    ), overlap
+"""
+
+# Sanctioned spellings: the read rides a key call's arguments, or the
+# carrier goes into the key-reaching call whole.
+CKY_READ_OK = """\
+from pkg.keys import train_cache_key
+
+def name_program(config, model_config, mesh):
+    return train_cache_key(
+        model_config, mesh.shape, global_batch_size=8, seq_len=16,
+        zero1=config.zero1,
+    )
+
+def wrap_key(model_config, mesh):
+    return train_cache_key(
+        model_config, mesh.shape, global_batch_size=8, seq_len=16,
+    )
+
+def fold_whole(model_config, mesh):
+    hidden = model_config.seq_len
+    return wrap_key(model_config, mesh), hidden
+"""
+
+CKY_KEYS_NO_VARS = """\
+def train_cache_key(model_config, mesh_shape, *, global_batch_size):
+    return repr((model_config.vocab_size, tuple(mesh_shape),
+                 global_batch_size))
+"""
+
+
+def test_cky001_signature_parity_fires(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/keys.py": CKY_KEYS,
+        "pkg/build.py": CKY_BUILD_PARITY_BAD,
+    }, select=["CKY001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "build_sharded_train::overlap" in symbols
+
+
+def test_cky001_uncovered_knob_read_fires(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/keys.py": CKY_KEYS,
+        "pkg/build.py": CKY_BUILD_PARITY_BAD,
+        "pkg/caller.py": CKY_READ_BAD,
+    }, select=["CKY001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "make_programs::config.overlap" in symbols
+
+
+def test_cky001_covered_spellings_are_clean(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/keys.py": CKY_KEYS,
+        "pkg/build.py": CKY_BUILD_OK,
+        "pkg/caller.py": CKY_READ_OK,
+    }, select=["CKY001"])
+    assert report.findings == []
+
+
+def test_cky001_missing_vars_fold_fires(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/keys.py": CKY_KEYS_NO_VARS,
+    }, select=["CKY001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "train_cache_key::vars" in symbols
+
+
+def test_cky001_inline_suppression(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/keys.py": CKY_KEYS,
+        "pkg/build.py": CKY_BUILD_PARITY_BAD,
+        "pkg/caller.py": CKY_READ_SUPPRESSED,
+    }, select=["CKY001"])
+    assert "make_programs::config.overlap" not in {
+        f.symbol for f in report.findings
+    }
+    assert report.suppressed >= 1
+
+
+def test_cky001_silent_without_key_functions(tmp_path):
+    """Trees that define no cache key (fixtures, partial lints) must not
+    drown in findings — the rule guards a contract, not a style."""
+    report = lint_files(tmp_path, {
+        "pkg/app.py": "def run(config):\n    return config.zero1\n",
+    }, select=["CKY001"])
+    assert report.findings == []
+
+
+# -- TEL001: telemetry emit -> route -> render contract --------------------
+
+TEL_TELEMETRY = """\
+def event(name, /, duration_s=0.0, t_mono=None, **attrs):
+    return (name, duration_s, attrs)
+
+def span(name, /, **attrs):
+    return name
+"""
+
+TEL_MASTER = """\
+class SpeedMonitor:
+    def record_fault(self, seam, kind, seconds):
+        pass
+
+class Servicer:
+    def _report_telemetry(self, events):
+        for name, duration_s, attrs in events:
+            if name == "fault":
+                self.speed_monitor.record_fault(
+                    attrs.get("seam"), attrs.get("kind"), duration_s
+                )
+"""
+
+TEL_WORKER_ROUTED = """\
+from pkg import telemetry
+
+def report(seam):
+    telemetry.event("fault", seam=seam)
+"""
+
+TEL_WORKER_UNROUTED = """\
+from pkg import telemetry
+
+def report():
+    telemetry.event("retry")
+"""
+
+TEL_WORKER_TIMED = """\
+from pkg import telemetry
+
+def report(dt):
+    telemetry.event("compile", duration_s=dt)
+"""
+
+TEL_WORKER_SUPPRESSED = """\
+from pkg import telemetry
+
+def report():
+    telemetry.event("retry")  # tracelint: disable=TEL001
+"""
+
+TEL_MASTER_DEAD_ROUTE = """\
+class Servicer:
+    def _report_telemetry(self, events):
+        for name, duration_s, attrs in events:
+            if name == "ghost":
+                self.count += 1
+"""
+
+TEL_RENDER = """\
+class Timeline:
+    def bump(self, name, n=1):
+        self._counters[name] = self._counters.get(name, 0) + n
+
+    def note(self):
+        self.bump("orphan")
+
+    def render_metrics(self):
+        lines = []
+
+        def gauge(name, value, help_text="", labels=""):
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{labels} {value}")
+
+        gauge("dlrover_good_total", 1, "a documented counter")
+        gauge("dlrover_bare_total", 2)
+        return lines
+"""
+
+TEL_SPEED_MONITOR_DRIFT = """\
+class SpeedMonitor:
+    def record_used(self, node):
+        pass
+
+    def record_orphan(self, node):
+        pass
+
+class Servicer:
+    def _report_telemetry(self, events):
+        for name, duration_s, attrs in events:
+            if name == "used":
+                self.speed_monitor.record_used(attrs["node"])
+            elif name == "gone":
+                self.speed_monitor.record_gone(attrs["node"])
+"""
+
+
+def test_tel001_unrouted_instant_event_fires(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/telemetry.py": TEL_TELEMETRY,
+        "pkg/master.py": TEL_MASTER,
+        "pkg/worker.py": TEL_WORKER_ROUTED,
+        "pkg/flaky.py": TEL_WORKER_UNROUTED,
+    }, select=["TEL001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "event::retry" in symbols
+    assert "event::fault" not in symbols
+
+
+def test_tel001_timed_events_are_exempt(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/telemetry.py": TEL_TELEMETRY,
+        "pkg/master.py": TEL_MASTER,
+        "pkg/worker.py": TEL_WORKER_ROUTED,
+        "pkg/timed.py": TEL_WORKER_TIMED,
+    }, select=["TEL001"])
+    assert report.findings == []
+
+
+def test_tel001_dead_route_fires(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/telemetry.py": TEL_TELEMETRY,
+        "pkg/master.py": TEL_MASTER_DEAD_ROUTE,
+    }, select=["TEL001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "route::ghost" in symbols
+
+
+def test_tel001_silent_without_routing_functions(tmp_path):
+    """Single-file fixtures with no master in sight emit freely."""
+    report = lint_files(tmp_path, {
+        "pkg/telemetry.py": TEL_TELEMETRY,
+        "pkg/worker.py": TEL_WORKER_UNROUTED,
+    }, select=["TEL001"])
+    assert report.findings == []
+
+
+def test_tel001_gauge_help_and_orphan_counter(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/timeline.py": TEL_RENDER,
+    }, select=["TEL001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "gauge::dlrover_bare_total" in symbols
+    assert "gauge::dlrover_good_total" not in symbols
+    assert "counter::orphan" in symbols
+
+
+def test_tel001_speed_monitor_surface_drift(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/telemetry.py": TEL_TELEMETRY,
+        "pkg/master.py": TEL_SPEED_MONITOR_DRIFT,
+        "pkg/worker.py": (
+            "from pkg import telemetry\n\n"
+            "def a():\n    telemetry.event(\"used\")\n\n"
+            "def b():\n    telemetry.event(\"gone\")\n"
+        ),
+    }, select=["TEL001"])
+    symbols = {f.symbol for f in report.findings}
+    assert "speed_monitor::record_gone" in symbols
+    assert "speed_monitor::orphan::record_orphan" in symbols
+    assert "speed_monitor::orphan::record_used" not in symbols
+
+
+def test_tel001_inline_suppression(tmp_path):
+    report = lint_files(tmp_path, {
+        "pkg/telemetry.py": TEL_TELEMETRY,
+        "pkg/master.py": TEL_MASTER,
+        "pkg/flaky.py": TEL_WORKER_SUPPRESSED,
+    }, select=["TEL001"])
+    assert "event::retry" not in {f.symbol for f in report.findings}
+    assert report.suppressed >= 1
+
+
+# -- LCK001: lockset races (CFG must-hold analysis) ------------------------
+
+LCK_INCONSISTENT = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._value += 1
+
+    def reset(self):
+        self._value = 0
+"""
+
+LCK_DISJOINT = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._value = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._a_lock:
+                self._value += 1
+
+    def snapshot(self):
+        with self._b_lock:
+            return self._value
+"""
+
+# acquire()/try/finally/release() is a held lock — the lexical heuristic
+# (THR001) cannot see it, the must-hold dataflow can.
+LCK_TRY_FINALLY_OK = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            self._lock.acquire()
+            try:
+                self._value += 1
+            finally:
+                self._lock.release()
+
+    def snapshot(self):
+        with self._lock:
+            return self._value
+"""
+
+LCK_CONSISTENT = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._value += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._value
+"""
+
+LCK_SUPPRESSED = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._value += 1
+
+    def reset(self):
+        self._value = 0  # tracelint: disable=LCK001
+"""
+
+
+def test_lck001_inconsistent_guard_fires(tmp_path):
+    report = lint(tmp_path, "m.py", LCK_INCONSISTENT, select=["LCK001"])
+    assert rule_ids(report) == ["LCK001"]
+    assert report.findings[0].symbol == "Pump._value"
+    assert "empty lockset" in report.findings[0].message
+
+
+def test_lck001_disjoint_locksets_fire(tmp_path):
+    report = lint(tmp_path, "m.py", LCK_DISJOINT, select=["LCK001"])
+    assert rule_ids(report) == ["LCK001"]
+    assert "disjoint" in report.findings[0].message
+    # The lexical heuristic calls both sides "locked" and stays silent —
+    # this race shape is exactly what the lockset analysis adds.
+    assert rule_ids(
+        lint(tmp_path, "m2.py", LCK_DISJOINT, select=["THR001"])
+    ) == []
+
+
+def test_lck001_try_finally_acquire_is_held(tmp_path):
+    report = lint(tmp_path, "m.py", LCK_TRY_FINALLY_OK, select=["LCK001"])
+    assert report.findings == []
+    # ...while the lexical heuristic false-positives on the same code:
+    # the motivating THR001 -> LCK001 precision delta.
+    assert rule_ids(
+        lint(tmp_path, "m2.py", LCK_TRY_FINALLY_OK, select=["THR001"])
+    ) == ["THR001"]
+
+
+def test_lck001_consistent_locking_is_clean(tmp_path):
+    report = lint(tmp_path, "m.py", LCK_CONSISTENT, select=["LCK001"])
+    assert report.findings == []
+
+
+def test_lck001_fully_unguarded_attr_is_thr001_territory(tmp_path):
+    source = LCK_INCONSISTENT.replace(
+        "            with self._lock:\n                self._value += 1",
+        "            self._value += 1",
+    )
+    report = lint(tmp_path, "m.py", source, select=["LCK001"])
+    assert report.findings == []
+    assert rule_ids(
+        lint(tmp_path, "m2.py", source, select=["THR001"])
+    ) == ["THR001"]
+
+
+def test_lck001_inline_suppression(tmp_path):
+    report = lint(tmp_path, "m.py", LCK_SUPPRESSED, select=["LCK001"])
+    assert report.findings == []
+    assert report.suppressed == 1
+
+
+# -- SARIF: new rules advertised with stable indices -----------------------
+
+def test_sarif_rule_indices_cover_new_rules(tmp_path):
+    report = lint(tmp_path, "m.py", LCK_INCONSISTENT, select=None)
+    sarif = json.loads(report.render_sarif())
+    driver_rules = sarif["runs"][0]["tool"]["driver"]["rules"]
+    ids = [r["id"] for r in driver_rules]
+    assert ids == sorted(ids), "ruleIndex must follow sorted rule ids"
+    for rule_id in ("CKY001", "TEL001", "LCK001"):
+        assert rule_id in ids
+    for result in sarif["runs"][0]["results"]:
+        assert ids[result["ruleIndex"]] == result["ruleId"]

@@ -9,6 +9,8 @@ Usage::
     python tools/tracelint.py --list-rules
     python tools/tracelint.py pkg --select TRC002,THR001
     python tools/tracelint.py pkg --write-baseline   # grandfather findings
+    python tools/tracelint.py dlrover_tpu --changed  # vs HEAD, plus the
+                                                     # reverse-import closure
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error (stable; the
 tier-1 gate in ``tests/test_lint_gate.py`` keys on them).
@@ -64,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="write current findings to the baseline file and exit 0",
     )
     parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="incremental mode: run per-file rules only on files changed "
+        "vs REF (git diff; default HEAD) plus every analyzed file that "
+        "transitively imports one of them; project-scope rules still "
+        "see the whole tree",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true",
         help="print registered rules and exit",
     )
@@ -72,6 +82,40 @@ def build_parser() -> argparse.ArgumentParser:
         help="root for repo-relative finding paths (default: repo root)",
     )
     return parser
+
+
+def _changed_closure(paths, root, ref):
+    """Repo-relative paths of ``.py`` files changed vs ``ref`` plus their
+    reverse-import closure over the analyzed tree; ``None`` (lint
+    everything) when git is unavailable or the diff fails."""
+    import subprocess
+
+    from dlrover_tpu.analysis import load_project
+
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "*.py"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        print(
+            f"tracelint: git diff vs {ref!r} failed "
+            f"({out.stderr.strip() or 'unknown error'}); "
+            "linting everything",
+            file=sys.stderr,
+        )
+        return None
+    changed = {
+        line.strip().replace(os.sep, "/")
+        for line in out.stdout.splitlines()
+        if line.strip().endswith(".py")
+    }
+    if not changed:
+        return set()
+    project = load_project(paths, root)
+    return project.reverse_import_closure(sorted(changed))
 
 
 def main(argv=None) -> int:
@@ -104,9 +148,18 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return EXIT_ERROR
 
+    only_files = None
+    if args.changed is not None:
+        only_files = _changed_closure(paths, args.root, args.changed)
+        if only_files is not None and not only_files:
+            print("tracelint: no analyzed files changed vs "
+                  f"{args.changed}; nothing to lint")
+            return 0
+
     try:
         report = run_paths(
-            paths, select=select, baseline=baseline, root=args.root
+            paths, select=select, baseline=baseline, root=args.root,
+            only_files=only_files,
         )
     except KeyError as e:  # unknown rule id
         print(f"tracelint: {e.args[0]}", file=sys.stderr)
